@@ -1,0 +1,30 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities
+of PaddlePaddle Fluid v0.11 (reference layout: SURVEY.md), built on
+JAX/XLA/pjit/Pallas. Programs are IR (ops/blocks/vars); the Executor
+JIT-compiles whole blocks to fused XLA programs; parallelism is GSPMD
+sharding over a device mesh instead of NCCL/parameter servers.
+"""
+
+from .core.scope import Scope, global_scope, reset_global_scope  # noqa
+from .core.lod import LoDTensor, RaggedPair  # noqa
+from .core.backward import append_backward, calc_gradient  # noqa
+from . import ops  # noqa  (registers all op types)
+from .framework import (  # noqa
+    Program, Variable, Parameter, Block, default_main_program,
+    default_startup_program, program_guard, unique_name,
+    reset_default_programs,
+)
+from .executor import Executor, CPUPlace, TPUPlace  # noqa
+from .layer_helper import LayerHelper, ParamAttr  # noqa
+from . import layers  # noqa
+from . import initializer  # noqa
+from . import optimizer  # noqa
+from . import regularizer  # noqa
+from . import clip  # noqa
+from . import nets  # noqa
+from . import io  # noqa
+from . import metrics  # noqa
+from . import profiler  # noqa
+from .parallel import ParallelExecutor  # noqa
+
+__version__ = "0.1.0"

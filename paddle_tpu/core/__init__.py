@@ -1,0 +1,4 @@
+from .ir import Program, BlockDesc, OpDesc, VarDesc  # noqa: F401
+from .scope import Scope, global_scope, reset_global_scope  # noqa: F401
+from .lod import LoDTensor, RaggedPair  # noqa: F401
+from .registry import OpRegistry, register_op, register_grad  # noqa: F401

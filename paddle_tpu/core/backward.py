@@ -1,0 +1,234 @@
+"""Desc-level autodiff: append gradient ops to a Program.
+
+Capability-equivalent of the reference's append_backward
+(reference: python/paddle/fluid/backward.py:273-425 + grad_op_desc_maker.h:33):
+ops are walked in reverse, a grad-op description is appended per forward op,
+and duplicate gradient contributions are summed. Ops may register an explicit
+grad maker; every op without one gets the generic `__vjp__` grad op, whose
+compute rule calls jax.vjp on the forward compute rule — exact gradients with
+no per-op adjoint code, and XLA's CSE dedups the recomputed forward values
+against the original forward ops after fusion.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from .ir import BlockDesc, OpDesc, Program, VarDesc
+from .registry import GRAD_SUFFIX, OpRegistry, grad_var_name
+
+_FLOAT_DTYPES = ("float16", "bfloat16", "float32", "float64")
+
+
+def _is_differentiable(var: Optional[VarDesc]) -> bool:
+    if var is None:
+        return False
+    if var.stop_gradient:
+        return False
+    return var.dtype in _FLOAT_DTYPES
+
+
+class _GradAccumulator:
+    """Tracks gradient contributions per forward var; sums duplicates."""
+
+    def __init__(self, block: BlockDesc):
+        self.block = block
+        self.contribs: Dict[str, List[str]] = {}
+        self._uid = 0
+
+    def fresh_name(self, fwd_name: str) -> str:
+        self._uid += 1
+        return f"{grad_var_name(fwd_name)}@RENAME@{self._uid}"
+
+    def add(self, fwd_name: str, grad_name: str):
+        self.contribs.setdefault(fwd_name, []).append(grad_name)
+
+    def has(self, fwd_name: str) -> bool:
+        return bool(self.contribs.get(fwd_name))
+
+    def materialize(self, fwd_name: str) -> str:
+        """Return the name of the (summed) gradient of fwd_name, appending a
+        sum op if there are multiple contributions."""
+        names = self.contribs[fwd_name]
+        target = grad_var_name(fwd_name)
+        if len(names) == 1:
+            if names[0] != target:
+                # single renamed contribution: alias via identity-sum
+                self.block.append_op("sum", {"X": [names[0]]}, {"Out": [target]})
+                self._declare_grad_var(fwd_name, target)
+                self.contribs[fwd_name] = [target]
+            return target
+        self.block.append_op("sum", {"X": list(names)}, {"Out": [target]})
+        self._declare_grad_var(fwd_name, target)
+        self.contribs[fwd_name] = [target]
+        return target
+
+    def _declare_grad_var(self, fwd_name: str, grad_name: str):
+        fwd = self.block.find_var_recursive(fwd_name)
+        if fwd is not None and not self.block.has_var(grad_name):
+            self.block.create_var(grad_name, shape=fwd.shape, dtype=fwd.dtype,
+                                  lod_level=fwd.lod_level)
+
+
+def _generic_grad_op(op: OpDesc, block: BlockDesc, acc: _GradAccumulator,
+                     no_grad: Set[str]) -> Optional[OpDesc]:
+    """Build the generic vjp-based grad op for `op`. Returns None if no input
+    needs a gradient or no output has one."""
+    opdef = OpRegistry.get(op.type)
+
+    fwd_in_entries: List[Tuple[str, str]] = []   # (slot, var name), flattened
+    for slot, names in op.inputs.items():
+        for n in names:
+            fwd_in_entries.append((slot, n))
+    fwd_out_names = op.output_names()
+
+    out_has_grad = [acc.has(n) for n in fwd_out_names]
+    if not any(out_has_grad):
+        return None
+
+    in_need_grad = []
+    for slot, n in fwd_in_entries:
+        var = block.find_var_recursive(n)
+        need = (slot not in opdef.no_grad_slots and n not in no_grad
+                and _is_differentiable(var))
+        in_need_grad.append(need)
+    if not any(in_need_grad):
+        return None
+
+    out_grad_names = [acc.materialize(n)
+                      for n, h in zip(fwd_out_names, out_has_grad) if h]
+
+    grad_outputs: List[str] = []
+    produced: Dict[str, str] = {}
+    for (slot, n), need in zip(fwd_in_entries, in_need_grad):
+        if not need:
+            continue
+        # Duplicate appearances of the same var each get a renamed grad
+        # output; the accumulator sums them later.
+        gname = acc.fresh_name(n) if (n in produced or acc.has(n)) \
+            else grad_var_name(n)
+        produced.setdefault(n, gname)
+        grad_outputs.append(gname)
+        acc.add(n, gname)
+        fwd = block.find_var_recursive(n)
+        if fwd is not None:
+            block.create_var(gname, shape=fwd.shape, dtype=fwd.dtype,
+                             lod_level=fwd.lod_level)
+
+    gop = OpDesc(
+        "__vjp__",
+        inputs={"FwdIn": [n for _, n in fwd_in_entries],
+                "OutGrad": out_grad_names},
+        outputs={"InGrad": grad_outputs},
+        attrs={"fwd_op": op.to_dict(),
+               "out_has_grad": out_has_grad,
+               "in_need_grad": in_need_grad},
+    )
+    return gop
+
+
+def append_backward(loss, parameter_list: Optional[Sequence[str]] = None,
+                    no_grad_set: Optional[Set[str]] = None,
+                    program: Optional[Program] = None):
+    """Append grad ops computing d(loss)/d(param) for every trainable param.
+
+    `loss` is a Variable (has .name/.block) or a var name in the program's
+    global block. Returns [(param VarDesc-or-Variable, grad name)] pairs.
+    """
+    from .. import framework  # late import to avoid cycle
+
+    if hasattr(loss, "block"):
+        block = loss.block.desc if hasattr(loss.block, "desc") else loss.block
+        prog = loss.block.program if hasattr(loss.block, "program") else program
+        loss_name = loss.name
+    else:
+        prog = program or framework.default_main_program()
+        block = prog.desc.global_block if hasattr(prog, "desc") \
+            else prog.global_block
+        loss_name = loss
+    if hasattr(prog, "desc"):
+        prog_desc = prog.desc
+    else:
+        prog_desc = prog
+
+    no_grad = set(no_grad_set or ())
+    for v in block.vars.values():
+        if v.stop_gradient:
+            no_grad.add(v.name)
+
+    acc = _GradAccumulator(block)
+
+    # Seed: d(loss)/d(loss) = 1.
+    loss_var = block.var(loss_name)
+    seed_name = grad_var_name(loss_name)
+    block.create_var(seed_name, shape=loss_var.shape or [1],
+                     dtype=loss_var.dtype)
+    fwd_op_count = len(block.ops)
+    block.append_op("fill_constant_like",
+                    {"X": [loss_name]}, {"Out": [seed_name]},
+                    {"value": 1.0, "dtype": loss_var.dtype})
+    acc.add(loss_name, seed_name)
+
+    # Reverse walk over the forward ops only.
+    for op in reversed(block.ops[:fwd_op_count]):
+        opdef = OpRegistry.get(op.type)
+        if opdef.grad_maker is not None:
+            if not any(acc.has(n) for n in op.output_names()):
+                continue
+            grad_ops = opdef.grad_maker(op, block, acc, no_grad)
+            for gop in grad_ops or []:
+                block.ops.append(gop)
+        else:
+            gop = _generic_grad_op(op, block, acc, no_grad)
+            if gop is not None:
+                block.ops.append(gop)
+    prog_desc._bump_version()
+
+    # Materialize summed grads for all trainable parameters.
+    params_and_grads = []
+    if parameter_list is not None:
+        param_names = list(parameter_list)
+    else:
+        param_names = [v.name for v in prog_desc.all_parameters()
+                       if v.trainable]
+    for pname in param_names:
+        if pname in no_grad or not acc.has(pname):
+            continue
+        gname = acc.materialize(pname)
+        params_and_grads.append((pname, gname))
+    return params_and_grads
+
+
+def calc_gradient(targets, inputs, program: Optional[Program] = None):
+    """Gradients of sum(targets) w.r.t. arbitrary vars (fluid.gradients
+    parity). Returns list of grad var names aligned with `inputs`."""
+    tgt = list(targets) if isinstance(targets, (list, tuple)) else [targets]
+    if len(tgt) > 1:
+        # Differentiate the sum of all targets, as fluid.gradients does.
+        first = tgt[0]
+        block = first.block.desc if hasattr(first.block, "desc") \
+            else first.block
+        from ..framework import unique_name
+        total_name = unique_name("grad_targets_sum")
+        t0 = block.var(tgt[0].name if hasattr(tgt[0], "name") else tgt[0])
+        block.create_var(total_name, shape=t0.shape, dtype=t0.dtype)
+        block.append_op(
+            "sum",
+            {"X": [t.name if hasattr(t, "name") else t for t in tgt]},
+            {"Out": [total_name]})
+        target = total_name
+        prog = first.block.program if hasattr(first, "block") else program
+        pairs = append_backward(target, parameter_list=[
+            i if isinstance(i, str) else i.name for i in
+            (inputs if isinstance(inputs, (list, tuple)) else [inputs])],
+            program=prog)
+    else:
+        pairs = append_backward(tgt[0], parameter_list=[
+            i if isinstance(i, str) else i.name for i in
+            (inputs if isinstance(inputs, (list, tuple)) else [inputs])],
+            program=program)
+    by_name = dict(pairs)
+    names = [i if isinstance(i, str) else i.name
+             for i in (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+    return [by_name.get(n) for n in names]

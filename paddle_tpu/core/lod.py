@@ -1,0 +1,123 @@
+"""Ragged (variable-length sequence) tensor support.
+
+The reference carries variable-length sequences as LoDTensor: a dense buffer
+plus nested level-of-detail offset tables (reference: lod_tensor.h:55-107),
+letting ops work padding-free. Under XLA's static-shape regime the idiomatic
+equivalent is dense padded data + a lengths vector + masking; `RaggedPair`
+is the in-graph representation and `LoDTensor` the host-side container that
+converts between offset-based LoD and padded form.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+try:
+    import jax.numpy as jnp
+except Exception:  # pragma: no cover - import-time fallback for docs tooling
+    jnp = None
+
+
+class RaggedPair:
+    """In-graph ragged value: (padded data, per-sequence lengths).
+
+    data: [num_seqs, max_len, *feature_dims] (padded with zeros)
+    lengths: int32 [num_seqs]
+    """
+
+    __slots__ = ("data", "lengths")
+
+    def __init__(self, data, lengths):
+        self.data = data
+        self.lengths = lengths
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def mask(self):
+        """[num_seqs, max_len] boolean validity mask."""
+        max_len = self.data.shape[1]
+        pos = jnp.arange(max_len, dtype=jnp.int32)[None, :]
+        return pos < self.lengths[:, None]
+
+    def tree_flatten(self):
+        return (self.data, self.lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def _register_pytree():
+    try:
+        import jax
+        jax.tree_util.register_pytree_node(
+            RaggedPair,
+            lambda rp: ((rp.data, rp.lengths), None),
+            lambda aux, ch: RaggedPair(*ch))
+    except Exception:
+        pass
+
+
+_register_pytree()
+
+
+def lod_to_lengths(lod_level0: Sequence[int]) -> np.ndarray:
+    """Offsets [0, 3, 5, 9] -> lengths [3, 2, 4]."""
+    off = np.asarray(lod_level0, dtype=np.int64)
+    return (off[1:] - off[:-1]).astype(np.int32)
+
+
+def lengths_to_lod(lengths: Sequence[int]) -> List[int]:
+    out = [0]
+    for l in lengths:
+        out.append(out[-1] + int(l))
+    return out
+
+
+class LoDTensor:
+    """Host-side ragged tensor: flat data + LoD offsets (reference parity).
+
+    Only level-1 LoD is carried losslessly into the graph (as RaggedPair);
+    deeper nesting is preserved on the host for feed/fetch round-trips.
+    """
+
+    def __init__(self, data: np.ndarray, lod: Optional[List[List[int]]] = None):
+        self.data = np.asarray(data)
+        self.lod = lod or []
+
+    @classmethod
+    def from_sequences(cls, seqs: List[np.ndarray]) -> "LoDTensor":
+        flat = np.concatenate([np.asarray(s) for s in seqs], axis=0)
+        return cls(flat, [lengths_to_lod([len(s) for s in seqs])])
+
+    def sequences(self) -> List[np.ndarray]:
+        if not self.lod:
+            return [self.data]
+        off = self.lod[0]
+        return [self.data[off[i]:off[i + 1]] for i in range(len(off) - 1)]
+
+    def to_padded(self, max_len: Optional[int] = None):
+        """-> (padded [n, max_len, *feat], lengths int32 [n])."""
+        seqs = self.sequences()
+        lengths = np.asarray([len(s) for s in seqs], dtype=np.int32)
+        ml = int(max_len or (lengths.max() if len(lengths) else 0))
+        feat = self.data.shape[1:]
+        out = np.zeros((len(seqs), ml) + tuple(feat), dtype=self.data.dtype)
+        for i, s in enumerate(seqs):
+            out[i, :len(s)] = s
+        return out, lengths
+
+    @classmethod
+    def from_padded(cls, padded: np.ndarray, lengths: np.ndarray) -> "LoDTensor":
+        seqs = [padded[i, :int(l)] for i, l in enumerate(lengths)]
+        return cls.from_sequences(seqs)
+
+    def __repr__(self):
+        return f"LoDTensor(shape={self.data.shape}, lod={self.lod})"

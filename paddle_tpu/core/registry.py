@@ -1,0 +1,147 @@
+"""Operator registry: compute rules, shape inference, and gradient makers.
+
+Capability-equivalent of the reference's OpRegistry/OpInfoMap + GradOpDescMaker
+(reference: paddle/fluid/framework/op_registry.h:36-196, op_info.h:68,
+grad_op_desc_maker.h:33) redesigned for XLA lowering: instead of per-device
+kernels keyed by (place, dtype, layout, library), each op has ONE pure-JAX
+compute rule, traced under jit so XLA picks the device code. Gradients come
+from per-op grad makers that append grad OpDescs at the IR level (desc-level
+autodiff); ops without an explicit maker fall back to a generic vjp-based
+grad op, which is exact because every compute rule is differentiable JAX.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+class OpDef:
+    """Everything the runtime knows about one op type."""
+
+    def __init__(self, type: str,
+                 compute: Callable,
+                 infer_shape: Optional[Callable] = None,
+                 grad_maker: Optional[Callable] = None,
+                 no_grad_slots: Optional[List[str]] = None,
+                 stateful: bool = False):
+        self.type = type
+        # compute(ctx) -> None; reads ctx.input/attr, writes ctx.set_output.
+        self.compute = compute
+        # infer_shape(block, op) -> None; fills output VarDesc shapes/dtypes at
+        # build time (reference: shape_inference.h:28). Optional: the JAX trace
+        # is the authoritative shape check at compile time.
+        self.infer_shape = infer_shape
+        # grad_maker(op, block, grad_sub_block) -> List[OpDesc]
+        self.grad_maker = grad_maker
+        # input slots that never need gradients (e.g. integer indices)
+        self.no_grad_slots = set(no_grad_slots or [])
+        self.stateful = stateful
+
+    def __repr__(self):
+        return f"OpDef({self.type})"
+
+
+class OpRegistry:
+    _ops: Dict[str, OpDef] = {}
+
+    @classmethod
+    def register(cls, opdef: OpDef):
+        if opdef.type in cls._ops:
+            raise ValueError(f"op {opdef.type!r} registered twice")
+        cls._ops[opdef.type] = opdef
+
+    @classmethod
+    def get(cls, type: str) -> OpDef:
+        if type not in cls._ops:
+            raise KeyError(f"op {type!r} is not registered; known ops: "
+                           f"{sorted(cls._ops)[:20]}...")
+        return cls._ops[type]
+
+    @classmethod
+    def has(cls, type: str) -> bool:
+        return type in cls._ops
+
+    @classmethod
+    def all_ops(cls) -> List[str]:
+        return sorted(cls._ops)
+
+
+def register_op(type: str, infer_shape=None, grad_maker=None,
+                no_grad_slots=None, stateful=False):
+    """Decorator: register `fn(ctx)` as the compute rule for op `type`."""
+    def deco(fn):
+        OpRegistry.register(OpDef(type, fn, infer_shape=infer_shape,
+                                  grad_maker=grad_maker,
+                                  no_grad_slots=no_grad_slots,
+                                  stateful=stateful))
+        return fn
+    return deco
+
+
+def register_grad(type: str):
+    """Decorator: attach a grad maker to an already-registered op.
+
+    The maker signature is maker(op, block) -> list[OpDesc-dict or OpDesc].
+    It receives the forward OpDesc and the block holding forward vars, and
+    returns grad op descriptions whose outputs are `<var>@GRAD` names.
+    """
+    def deco(fn):
+        OpRegistry.get(type).grad_maker = fn
+        return fn
+    return deco
+
+
+class ExecutionContext:
+    """Per-op view of the environment during lowering/tracing.
+
+    Holds jnp arrays (tracers) for inputs; compute rules write outputs here.
+    A ragged (LoD) variable is represented as a `RaggedPair` of
+    (padded data, int32 lengths) — see core/lod.py.
+    """
+
+    __slots__ = ("op", "env", "_outputs", "extra")
+
+    def __init__(self, op, env: Dict[str, Any], extra: Optional[Dict] = None):
+        self.op = op
+        self.env = env
+        self._outputs: Dict[str, Any] = {}
+        self.extra = extra or {}
+
+    # inputs -------------------------------------------------------------
+    def input(self, slot: str):
+        """Single input for slot, or None if absent."""
+        names = self.op.input(slot)
+        if not names:
+            return None
+        return self.env[names[0]]
+
+    def inputs(self, slot: str) -> List[Any]:
+        return [self.env[n] for n in self.op.input(slot)]
+
+    def has_input(self, slot: str) -> bool:
+        names = self.op.input(slot)
+        return bool(names) and names[0] in self.env
+
+    # attrs --------------------------------------------------------------
+    def attr(self, name: str, default=None):
+        return self.op.attrs.get(name, default)
+
+    # outputs ------------------------------------------------------------
+    def set_output(self, slot: str, value, index: int = 0):
+        names = self.op.output(slot)
+        if not names:
+            return  # optional output not wired
+        self._outputs[names[index]] = value
+
+    def set_outputs(self, slot: str, values: List[Any]):
+        for i, v in enumerate(values):
+            self.set_output(slot, v, index=i)
+
+    @property
+    def outputs(self) -> Dict[str, Any]:
+        return self._outputs

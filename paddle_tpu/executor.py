@@ -1,0 +1,42 @@
+"""Top-level Executor + Places (reference: python/paddle/fluid/executor.py
+and platform/place.h). Place selection maps to JAX backends: TPUPlace is
+the default when TPU devices exist, CPUPlace forces the host backend."""
+from __future__ import annotations
+
+from .core.executor import Executor as _CoreExecutor
+
+
+class CPUPlace:
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace:
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
+
+
+# Alias kept for scripts written against the reference's CUDAPlace.
+CUDAPlace = TPUPlace
+
+
+class Executor(_CoreExecutor):
+    pass
+
+
+def scope_guard(scope):
+    import contextlib
+    from .core import scope as scope_mod
+
+    @contextlib.contextmanager
+    def guard():
+        old = scope_mod._global_scope
+        scope_mod._global_scope = scope
+        try:
+            yield
+        finally:
+            scope_mod._global_scope = old
+    return guard()

@@ -1,0 +1,391 @@
+"""Python graph-builder API: Program/Block/Variable/Parameter.
+
+Capability parity with the reference's python/paddle/fluid/framework.py
+(Variable:117, Operator:361, Block:644, Program:965, Parameter:1143,
+default_{startup,main}_program:1201, program_guard:1296). The builder
+appends OpDescs into the core IR (core/ir.py); no C++ round-trip is needed
+because the IR is native Python and shape checking happens at XLA trace time.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .core import ir
+from .core.ir import VAR_TYPE_LOD_TENSOR
+from .core.registry import OpRegistry
+
+
+class UniqueNameGenerator:
+    def __init__(self):
+        self.ids: Dict[str, int] = {}
+
+    def __call__(self, key: str) -> str:
+        idx = self.ids.get(key, 0)
+        self.ids[key] = idx + 1
+        return f"{key}_{idx}"
+
+    def reset(self):
+        self.ids = {}
+
+
+_name_gen = UniqueNameGenerator()
+
+
+def unique_name(key: str) -> str:
+    return _name_gen(key)
+
+
+class Variable:
+    """User-facing handle to a VarDesc inside a Block."""
+
+    def __init__(self, block: "Block", desc: ir.VarDesc):
+        self.block = block
+        self.desc = desc
+
+    @property
+    def name(self) -> str:
+        return self.desc.name
+
+    @property
+    def shape(self):
+        return tuple(self.desc.shape) if self.desc.shape is not None else None
+
+    @property
+    def dtype(self) -> str:
+        return self.desc.dtype
+
+    @property
+    def lod_level(self) -> int:
+        return self.desc.lod_level
+
+    @property
+    def persistable(self) -> bool:
+        return self.desc.persistable
+
+    @property
+    def stop_gradient(self) -> bool:
+        return self.desc.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v: bool):
+        self.desc.stop_gradient = v
+
+    @property
+    def program(self) -> "Program":
+        return self.block.program
+
+    def astype(self, dtype):
+        from .layers import tensor as tensor_layers
+        return tensor_layers.cast(self, dtype)
+
+    def __repr__(self):
+        return (f"Variable({self.name}, shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+    # Arithmetic sugar (reference: math_op_patch.py) — defined in
+    # layers/math_op_patch.py and monkey-patched onto this class.
+
+
+class Parameter(Variable):
+    """A trainable persistable Variable (reference: framework.py:1143)."""
+
+    def __init__(self, block: "Block", desc: ir.VarDesc,
+                 regularizer=None, gradient_clip_attr=None):
+        super().__init__(block, desc)
+        self.regularizer = regularizer
+        self.gradient_clip_attr = gradient_clip_attr
+
+    @property
+    def trainable(self) -> bool:
+        return self.desc.trainable
+
+    @trainable.setter
+    def trainable(self, v: bool):
+        self.desc.trainable = v
+
+
+class Block:
+    def __init__(self, program: "Program", desc: ir.BlockDesc):
+        self.program = program
+        self.desc = desc
+        self._var_objs: Dict[str, Variable] = {}
+
+    @property
+    def idx(self) -> int:
+        return self.desc.idx
+
+    def var(self, name: str) -> Variable:
+        if name in self._var_objs:
+            return self._var_objs[name]
+        vdesc = self.desc.find_var_recursive(name)
+        if vdesc is None:
+            raise KeyError(f"var {name!r} not in block {self.idx}")
+        v = Variable(self, vdesc)
+        self._var_objs[name] = v
+        return v
+
+    def has_var(self, name: str) -> bool:
+        return self.desc.find_var_recursive(name) is not None
+
+    def create_var(self, name: Optional[str] = None, shape=None,
+                   dtype="float32", lod_level: int = 0,
+                   persistable: bool = False, stop_gradient: bool = False,
+                   type: str = VAR_TYPE_LOD_TENSOR) -> Variable:
+        name = name or unique_name("tmp")
+        vdesc = self.desc.create_var(
+            name, shape=shape, dtype=dtype, lod_level=lod_level,
+            persistable=persistable, stop_gradient=stop_gradient, type=type)
+        v = Variable(self, vdesc)
+        self._var_objs[name] = v
+        return v
+
+    def create_parameter(self, name: Optional[str] = None, shape=None,
+                         dtype="float32", trainable: bool = True,
+                         regularizer=None, **kw) -> Parameter:
+        name = name or unique_name("param")
+        vdesc = self.desc.create_var(name, shape=shape, dtype=dtype,
+                                     persistable=True, is_parameter=True,
+                                     trainable=trainable)
+        p = Parameter(self, vdesc, regularizer=regularizer)
+        self._var_objs[name] = p
+        return p
+
+    def append_op(self, type: str, inputs: Optional[Dict] = None,
+                  outputs: Optional[Dict] = None,
+                  attrs: Optional[Dict] = None) -> ir.OpDesc:
+        if not OpRegistry.has(type):
+            raise KeyError(f"op type {type!r} is not registered")
+        op = self.desc.append_op(type, _names(inputs), _names(outputs),
+                                 attrs)
+        _infer_shapes(self.desc, op)
+        return op
+
+    def prepend_op(self, type: str, inputs=None, outputs=None, attrs=None):
+        return self.desc.prepend_op(type, _names(inputs), _names(outputs),
+                                    attrs)
+
+    @property
+    def ops(self) -> List[ir.OpDesc]:
+        return self.desc.ops
+
+
+# Build-time shape inference: abstractly evaluate the op's compute rule with
+# jax.eval_shape (no FLOPs, no device). This replaces the reference's per-op
+# InferShape functions (shape_inference.h:28) with one generic mechanism —
+# possible because every compute rule is shape-polymorphic JAX. The dynamic
+# batch dim (-1) maps to a distinctive dummy extent and back.
+_DUMMY_BATCH = 97
+_DUMMY_TIME = 13
+
+
+def _infer_shapes(block_desc: ir.BlockDesc, op: ir.OpDesc) -> None:
+    try:
+        _infer_shapes_impl(block_desc, op)
+    except Exception:
+        # Inference is best-effort at build time; the executor's trace is
+        # the authoritative shape check.
+        pass
+
+
+def _infer_shapes_impl(block_desc: ir.BlockDesc, op: ir.OpDesc) -> None:
+    import jax
+    import jax.numpy as jnp
+    from .core.lod import RaggedPair
+    from .core.registry import ExecutionContext
+    from .ops.core_ops import jnp_dtype
+
+    opdef = OpRegistry.get(op.type)
+    env = {}
+    for name in op.input_names():
+        v = block_desc.find_var_recursive(name)
+        if v is None or v.shape is None or v.dtype is None:
+            if op.type not in ("fill_constant", "uniform_random",
+                              "gaussian_random", "assign_value"):
+                return  # can't infer without input shapes
+            continue
+        shape = [(_DUMMY_BATCH if d == -1 else int(d)) for d in v.shape]
+        dt = jnp_dtype(v.dtype)
+        if v.lod_level > 0:
+            data = jax.ShapeDtypeStruct(
+                tuple([shape[0], _DUMMY_TIME] + shape[1:]), dt)
+            lengths = jax.ShapeDtypeStruct((shape[0],), jnp.int32)
+            env[name] = RaggedPair(data, lengths)
+        else:
+            env[name] = jax.ShapeDtypeStruct(tuple(shape), dt)
+
+    def run(inputs):
+        local = dict(inputs)
+        ctx = ExecutionContext(op, local, extra={
+            "prng": lambda seed: jax.random.PRNGKey(0),
+            "step": jnp.zeros((), jnp.int32),
+        })
+        opdef.compute(ctx)
+        return ctx.outputs
+
+    outs = jax.eval_shape(run, env)
+    for name, aval in outs.items():
+        v = block_desc.find_var_recursive(name)
+        if v is None:
+            continue
+        if isinstance(aval, RaggedPair):
+            shape = [(-1 if d == _DUMMY_BATCH else int(d))
+                     for i, d in enumerate(aval.data.shape) if i != 1]
+            if v.shape is None:
+                v.shape = shape
+            v.lod_level = max(v.lod_level, 1)
+            if v.dtype is None:
+                v.dtype = str(aval.data.dtype)
+        else:
+            shape = [(-1 if d == _DUMMY_BATCH else int(d))
+                     for d in aval.shape]
+            if v.shape is None:
+                v.shape = shape
+            if v.dtype is None:
+                v.dtype = str(aval.dtype)
+
+
+def _names(slot_map: Optional[Dict]) -> Dict[str, List[str]]:
+    out: Dict[str, List[str]] = {}
+    for slot, vs in (slot_map or {}).items():
+        if vs is None:
+            continue
+        if not isinstance(vs, (list, tuple)):
+            vs = [vs]
+        names = []
+        for v in vs:
+            if v is None:
+                continue
+            names.append(v if isinstance(v, str) else v.name)
+        if names:
+            out[slot] = names
+    return out
+
+
+class Program:
+    """Python Program wrapping the core IR program."""
+
+    def __init__(self):
+        self.desc = ir.Program()
+        self._blocks = [Block(self, self.desc.global_block)]
+        self._current_block_idx = 0
+
+    # -- structure ----------------------------------------------------------
+    @property
+    def random_seed(self):
+        return self.desc.random_seed
+
+    @random_seed.setter
+    def random_seed(self, seed):
+        self.desc.random_seed = seed
+
+    def global_block(self) -> Block:
+        return self._blocks[0]
+
+    def current_block(self) -> Block:
+        return self._blocks[self._current_block_idx]
+
+    def block(self, idx: int) -> Block:
+        return self._blocks[idx]
+
+    def create_block(self) -> Block:
+        parent = self.current_block()
+        bdesc = self.desc.append_block(parent.desc)
+        blk = Block(self, bdesc)
+        self._blocks.append(blk)
+        self._current_block_idx = bdesc.idx
+        return blk
+
+    def rollback(self):
+        self._current_block_idx = \
+            self.current_block().desc.parent_idx
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self._blocks)
+
+    # -- helpers ------------------------------------------------------------
+    def list_vars(self):
+        for blk in self._blocks:
+            for name in list(blk.desc.vars):
+                yield blk.var(name)
+
+    def all_parameters(self) -> List[Parameter]:
+        out = []
+        for blk in self._blocks:
+            for name, vdesc in blk.desc.vars.items():
+                if vdesc.is_parameter:
+                    out.append(blk.var(name))
+        return out
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program()
+        p.desc = self.desc.clone()
+        p._blocks = [Block(p, bd) for bd in p.desc.blocks]
+        if for_test:
+            for bd in p.desc.blocks:
+                for op in bd.ops:
+                    if "is_test" in _TEST_ATTR_OPS.get(op.type, ()):
+                        op.attrs["is_test"] = True
+        return p
+
+    def to_string(self) -> str:
+        return str(self.desc)
+
+    def __str__(self):
+        return self.to_string()
+
+
+_TEST_ATTR_OPS = {
+    "dropout": ("is_test",),
+    "batch_norm": ("is_test",),
+}
+
+# -- default programs -------------------------------------------------------
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+def switch_main_program(p: Program) -> Program:
+    global _main_program
+    old, _main_program = _main_program, p
+    return old
+
+
+def switch_startup_program(p: Program) -> Program:
+    global _startup_program
+    old, _startup_program = _startup_program, p
+    return old
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program,
+                  startup_program: Optional[Program] = None):
+    old_main = switch_main_program(main_program)
+    old_startup = None
+    if startup_program is not None:
+        old_startup = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(old_main)
+        if old_startup is not None:
+            switch_startup_program(old_startup)
+
+
+def reset_default_programs():
+    global _main_program, _startup_program
+    _main_program = Program()
+    _startup_program = Program()
+    _name_gen.reset()

@@ -1,0 +1,145 @@
+"""Checkpoint / model IO (reference: python/paddle/fluid/io.py —
+save_vars:66, save_params:132, save_persistables:145, load_persistables:234,
+save_inference_model:298, load_inference_model:383; save_op.cc/load_op.cc).
+
+TPU-native design: persistable variables live in the Scope as device
+arrays; save/load serializes them with numpy .npz (single-file "combine"
+form, like save_combine_op) plus the Program JSON for inference models.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .core.scope import global_scope
+from .framework import Program, default_main_program
+
+__all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
+           "load_params", "load_persistables", "save_inference_model",
+           "load_inference_model", "get_parameter_value",
+           "set_parameter_value"]
+
+
+def _vars_of(program: Program, predicate) -> List:
+    return [v for v in program.list_vars() if predicate(v.desc)]
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    program = main_program or default_main_program()
+    if vars is None:
+        vars = _vars_of(program, predicate or (lambda v: v.persistable))
+    os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+    arrays = {}
+    for v in vars:
+        val = scope.find(v.name)
+        if val is None:
+            continue
+        arrays[v.name] = np.asarray(val)
+    path = os.path.join(dirname, filename or "__params__.npz")
+    np.savez(path, **arrays)
+    return path
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=lambda v: v.is_parameter, filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=lambda v: v.persistable, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    program = main_program or default_main_program()
+    if vars is None:
+        vars = _vars_of(program, predicate or (lambda v: v.persistable))
+    import jax.numpy as jnp
+    path = os.path.join(dirname, filename or "__params__.npz")
+    data = np.load(path)
+    scope = global_scope()
+    for v in vars:
+        if v.name in data:
+            scope.set(v.name, jnp.asarray(data[v.name]))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program,
+              predicate=lambda v: v.is_parameter, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program,
+              predicate=lambda v: v.persistable, filename=filename)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None):
+    """Freeze program + params for inference (reference: io.py:298 +
+    framework/prune.cc pruning)."""
+    program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    pruned = _prune(program, feeded_var_names,
+                    [t.name for t in target_vars])
+    meta = {
+        "program": pruned.desc.to_dict(),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [t.name for t in target_vars],
+    }
+    with open(os.path.join(dirname, model_filename or "__model__.json"),
+              "w") as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, program,
+                      filename=params_filename or "__params__.npz")
+    return dirname
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    with open(os.path.join(dirname, model_filename or "__model__.json")) as f:
+        meta = json.load(f)
+    from .core import ir
+    prog = Program()
+    prog.desc = ir.Program.from_dict(meta["program"])
+    from .framework import Block
+    prog._blocks = [Block(prog, bd) for bd in prog.desc.blocks]
+    load_vars(executor, dirname, prog,
+              predicate=lambda v: v.persistable,
+              filename=params_filename or "__params__.npz")
+    fetch_vars = [prog.global_block().var(n) for n in meta["fetch_names"]]
+    return prog, meta["feed_names"], fetch_vars
+
+
+def _prune(program: Program, feed_names, fetch_names) -> Program:
+    """Keep only ops needed to compute fetch_names from feed_names
+    (reference: framework/prune.cc)."""
+    pruned = program.clone()
+    block = pruned.desc.global_block
+    needed = set(fetch_names)
+    keep = []
+    for op in reversed(block.ops):
+        if op.type in ("feed", "fetch"):
+            continue
+        if any(n in needed for n in op.output_names()):
+            keep.append(op)
+            for n in op.input_names():
+                needed.add(n)
+    block.ops = list(reversed(keep))
+    pruned.desc._bump_version()
+    return pruned
+
+
+def get_parameter_value(para, executor=None):
+    return np.asarray(global_scope().get(para.name))
+
+
+def set_parameter_value(para, value, executor=None):
+    import jax.numpy as jnp
+    global_scope().set(para.name, jnp.asarray(value))

@@ -1,0 +1,258 @@
+"""Control-flow layers: StaticRNN, While, array ops, cond.
+
+Reference parity: python/paddle/fluid/layers/control_flow.py
+(StaticRNN:383, While:608, IfElse:1252, DynamicRNN:1354, array ops).
+TPU-native design: these build sub-blocks in the IR which the executor
+lowers to jax.lax.scan / while_loop / cond — compiler-friendly control
+flow instead of the reference's nested-Executor interpretation
+(while_op.cc:35, recurrent_op.cc:222).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..framework import Variable, default_main_program
+from ..layer_helper import LayerHelper
+
+__all__ = ["StaticRNN", "While", "Switch", "increment_shared",
+           "array_write", "array_read", "array_length", "less_than_v",
+           "cond_op"]
+
+
+class StaticRNN:
+    """Fixed-length RNN over the time axis, lowered to one scan op.
+
+    Usage parity with reference StaticRNN (control_flow.py:383):
+        rnn = StaticRNN()
+        with rnn.step():
+            word = rnn.step_input(x_t)           # x_t: [T, B, D]
+            prev = rnn.memory(init=h0)           # or shape/value init
+            h = some_layers(word, prev)
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        outs = rnn()
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._inputs: List[Variable] = []
+        self._mem_init: List[Variable] = []
+        self._mem_pre: List[Variable] = []
+        self._mem_new: List[Optional[Variable]] = []
+        self._outputs: List[Variable] = []
+        self._block = None
+        self._parent_prog = None
+        self._entered = False
+
+    class _StepGuard:
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            prog = default_main_program()
+            self.rnn._parent_prog = prog
+            self.rnn._block = prog.create_block()
+            self.rnn._entered = True
+            return self.rnn
+
+        def __exit__(self, *exc):
+            self.rnn._entered = False
+            prog = self.rnn._parent_prog
+            prog.rollback()
+            self.rnn._finalize()
+            return False
+
+    def step(self):
+        return StaticRNN._StepGuard(self)
+
+    def step_input(self, x: Variable) -> Variable:
+        """x: [T, ...]; returns the per-step slice variable."""
+        sv = self._block.create_var(
+            name=f"{x.name}@step", shape=list(x.shape[1:]) if x.shape
+            else None, dtype=x.dtype)
+        self._inputs.append((x, sv))
+        return sv
+
+    def memory(self, init: Variable = None, shape=None, value=0.0,
+               dtype="float32") -> Variable:
+        if init is None:
+            # The init constant must live in the PARENT block (it feeds the
+            # static_rnn op there), not the step sub-block we're inside.
+            prog = self._parent_prog
+            parent = prog.block(self._block.desc.parent_idx)
+            from ..framework import unique_name
+            init = parent.create_var(name=unique_name("rnn_mem_init"),
+                                     shape=list(shape), dtype=dtype)
+            parent.append_op("fill_constant", outputs={"Out": init},
+                             attrs={"shape": list(shape), "dtype": dtype,
+                                    "value": float(value)})
+        pre = self._block.create_var(name=f"{init.name}@pre",
+                                     shape=list(init.shape)
+                                     if init.shape else None,
+                                     dtype=init.dtype)
+        self._mem_init.append(init)
+        self._mem_pre.append(pre)
+        self._mem_new.append(None)
+        return pre
+
+    def update_memory(self, pre: Variable, new: Variable):
+        idx = self._mem_pre.index(pre)
+        self._mem_new[idx] = new
+
+    def step_output(self, out: Variable):
+        self._outputs.append(out)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _finalize(self):
+        helper = self.helper
+        self._result_vars = [
+            helper.create_tmp_variable(o.dtype) for o in self._outputs]
+        helper.append_op(
+            type="static_rnn",
+            inputs={"X": [x for x, _ in self._inputs],
+                    "MemInit": self._mem_init},
+            outputs={"Out": self._result_vars},
+            attrs={"sub_block_idx": self._block.idx,
+                   "step_in_names": [sv.name for _, sv in self._inputs],
+                   "mem_pre_names": [v.name for v in self._mem_pre],
+                   "mem_new_names": [v.name for v in self._mem_new],
+                   "out_names": [o.name for o in self._outputs]})
+
+    def __call__(self):
+        res = self._result_vars
+        return res[0] if len(res) == 1 else res
+
+
+class While:
+    """While loop over a boolean condition var (reference:
+    control_flow.py:608 / while_op.cc). Loop-carried state is every var
+    the body writes that exists before the loop; lowered to
+    jax.lax.while_loop."""
+
+    def __init__(self, cond: Variable, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+        self._block = None
+
+    def block(self):
+        return While._Guard(self)
+
+    class _Guard:
+        def __init__(self, w):
+            self.w = w
+
+        def __enter__(self):
+            prog = default_main_program()
+            self.w._prog = prog
+            self.w._block = prog.create_block()
+            return self.w
+
+        def __exit__(self, *exc):
+            prog = self.w._prog
+            prog.rollback()
+            self.w._finalize()
+            return False
+
+    def _finalize(self):
+        blk = self._block
+        # loop-carried state: vars written in body that exist in parent
+        parent = self._prog.block(blk.desc.parent_idx)
+        written = []
+        for op in blk.desc.ops:
+            for n in op.output_names():
+                if parent.desc.find_var_recursive(n) is not None \
+                        and n not in written:
+                    written.append(n)
+        self.helper.append_op(
+            type="while", inputs={"Cond": self.cond_var},
+            outputs={"Out": written},
+            attrs={"sub_block_idx": blk.idx,
+                   "carried_names": written,
+                   "cond_name": self.cond_var.name})
+
+
+class Switch:
+    """Reference parity for layers.Switch (control_flow.py:1163): builds
+    nested conds. Minimal host-side version for LR schedules."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self.cases = []
+
+    def case(self, condition):
+        raise NotImplementedError(
+            "Switch is provided via learning_rate_scheduler host-side "
+            "schedules in the TPU build")
+
+    def default(self):
+        raise NotImplementedError
+
+
+def increment_shared(x, value=1.0):
+    from .nn import increment
+    return increment(x, value)
+
+
+def array_write(x, i, array=None):
+    """TensorArray write (reference: tensor_array_read_write_op.cc).
+    Arrays are dense [cap, ...] tensors with dynamic_update_slice."""
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = helper.create_tmp_variable(x.dtype)
+        array.desc.type = "tensor_array"
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="array_write",
+                     inputs={"X": x, "I": i, "Array": array},
+                     outputs={"Out": out})
+    return out
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.create_tmp_variable(array.dtype)
+    helper.append_op(type="array_read", inputs={"Array": array, "I": i},
+                     outputs={"Out": out})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_tmp_variable("int64")
+    helper.append_op(type="array_length", inputs={"Array": array},
+                     outputs={"Out": out})
+    return out
+
+
+def less_than_v(x, y):
+    helper = LayerHelper("less_than")
+    out = helper.create_tmp_variable("bool")
+    helper.append_op(type="less_than", inputs={"X": x, "Y": y},
+                     outputs={"Out": out})
+    return out
+
+
+def cond_op(pred, true_fn, false_fn):
+    """Functional cond: both branches are built as sub-blocks and lowered
+    to lax.cond (reference capability: conditional_block_op.cc)."""
+    prog = default_main_program()
+    helper = LayerHelper("cond")
+
+    tb = prog.create_block()
+    true_out = true_fn()
+    prog.rollback()
+    fb = prog.create_block()
+    false_out = false_fn()
+    prog.rollback()
+
+    out = helper.create_tmp_variable(true_out.dtype)
+    helper.append_op(type="cond",
+                     inputs={"Pred": pred},
+                     outputs={"Out": out},
+                     attrs={"true_block_idx": tb.idx,
+                            "false_block_idx": fb.idx,
+                            "true_out": true_out.name,
+                            "false_out": false_out.name})
+    return out

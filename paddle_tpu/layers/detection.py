@@ -1,0 +1,42 @@
+"""Detection layers (reference: paddle/fluid/operators/ detection ops —
+prior_box_op.cc, box_coder_op.cc, iou ops, multiclass_nms). Round-1 subset:
+prior_box and box_coder as pure-XLA ops; NMS follows in the detection
+op module (fixed-output-capacity TPU form)."""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["prior_box", "box_coder"]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5):
+    helper = LayerHelper("prior_box")
+    boxes = helper.create_tmp_variable(input.dtype)
+    variances = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="prior_box",
+                     inputs={"Input": input, "Image": image},
+                     outputs={"Boxes": boxes, "Variances": variances},
+                     attrs={"min_sizes": list(min_sizes),
+                            "max_sizes": list(max_sizes or []),
+                            "aspect_ratios": list(aspect_ratios),
+                            "variances": list(variance),
+                            "flip": flip, "clip": clip,
+                            "step_w": steps[0], "step_h": steps[1],
+                            "offset": offset})
+    return boxes, variances
+
+
+def box_coder(prior_box_var, prior_box_v, target_box,
+              code_type="encode_center_size", box_normalized=True):
+    helper = LayerHelper("box_coder")
+    out = helper.create_tmp_variable(target_box.dtype)
+    helper.append_op(type="box_coder",
+                     inputs={"PriorBox": prior_box_v,
+                             "PriorBoxVar": prior_box_var,
+                             "TargetBox": target_box},
+                     outputs={"OutputBox": out},
+                     attrs={"code_type": code_type,
+                            "box_normalized": box_normalized})
+    return out

@@ -1,0 +1,18 @@
+"""Input layers (reference: python/paddle/fluid/layers/io.py — data:29)."""
+from __future__ import annotations
+
+from ..framework import default_main_program, default_startup_program
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         type=None, stop_gradient=True, main_program=None):
+    """Declare an input variable fed at run time. With append_batch_size,
+    -1 is prepended as the batch dim (reference: layers/io.py:29)."""
+    prog = main_program or default_main_program()
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    var = prog.global_block().create_var(
+        name=name, shape=shape, dtype=dtype, lod_level=lod_level,
+        stop_gradient=stop_gradient)
+    return var

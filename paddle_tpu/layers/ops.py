@@ -1,0 +1,56 @@
+"""Auto-generated thin layer wrappers for simple ops.
+
+Reference parity: python/paddle/fluid/layers/ops.py via
+layer_function_generator.py — one python function per registered unary op.
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+_UNARY_OPS = [
+    "sigmoid", "logsigmoid", "exp", "tanh", "tanh_shrink", "sqrt", "rsqrt",
+    "abs", "ceil", "floor", "cos", "sin", "round", "reciprocal", "square",
+    "softplus", "softsign", "log", "sign",
+]
+
+__all__ = list(_UNARY_OPS) + ["uniform_random", "gaussian_random"]
+
+
+def _make_unary(op_type):
+    def fn(x, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_tmp_variable(x.dtype, lod_level=x.lod_level)
+        helper.append_op(type=op_type, inputs={"X": x},
+                         outputs={"Out": out})
+        return out
+    fn.__name__ = op_type
+    fn.__doc__ = f"Elementwise {op_type} (auto-generated wrapper)."
+    return fn
+
+
+for _op in _UNARY_OPS:
+    globals()[_op] = _make_unary(_op)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random")
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op(type="uniform_random", outputs={"Out": out},
+                     attrs={"shape": list(shape), "dtype": dtype,
+                            "min": min, "max": max,
+                            "seed": seed or
+                            helper.main_program.desc.next_seed()})
+    out.stop_gradient = True
+    return out
+
+
+def gaussian_random(shape, dtype="float32", mean=0.0, std=1.0, seed=0):
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op(type="gaussian_random", outputs={"Out": out},
+                     attrs={"shape": list(shape), "dtype": dtype,
+                            "mean": mean, "std": std,
+                            "seed": seed or
+                            helper.main_program.desc.next_seed()})
+    out.stop_gradient = True
+    return out

@@ -1,0 +1,112 @@
+"""Host-side streaming metrics / evaluators (reference:
+python/paddle/fluid/evaluator.py + metrics — Accuracy, ChunkEvaluator,
+EditDistance accumulation across batches)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Accuracy", "EditDistance", "CompositeMetric", "Auc"]
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args, **kw):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class Accuracy(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        self.value += float(value) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("no batches accumulated")
+        return self.value / self.weight
+
+
+class EditDistance(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+
+    def update(self, distances, seq_num):
+        self.total_distance += float(np.sum(distances))
+        self.seq_num += int(seq_num)
+
+    def eval(self):
+        return self.total_distance / max(self.seq_num, 1)
+
+
+class Auc(MetricBase):
+    def __init__(self, name=None, num_thresholds=200):
+        super().__init__(name)
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        n = self.num_thresholds
+        self.tp = np.zeros(n)
+        self.fp = np.zeros(n)
+        self.tn = np.zeros(n)
+        self.fn = np.zeros(n)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        labels = np.asarray(labels).reshape(-1)
+        pos_prob = preds[:, -1]
+        thresholds = np.linspace(0.0, 1.0, self.num_thresholds)
+        pos = labels > 0
+        for i, t in enumerate(thresholds):
+            pred_pos = pos_prob >= t
+            self.tp[i] += np.sum(pred_pos & pos)
+            self.fp[i] += np.sum(pred_pos & ~pos)
+            self.fn[i] += np.sum(~pred_pos & pos)
+            self.tn[i] += np.sum(~pred_pos & ~pos)
+
+    def eval(self):
+        tpr = self.tp / np.maximum(self.tp + self.fn, 1e-12)
+        fpr = self.fp / np.maximum(self.fp + self.tn, 1e-12)
+        order = np.argsort(fpr)
+        fpr, tpr = fpr[order], tpr[order]
+        return float(np.trapezoid(tpr, fpr))
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, *args):
+        for m, a in zip(self._metrics, args):
+            m.update(*a)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]

@@ -1,0 +1,122 @@
+"""Control-flow ops: sub-blocks lowered to lax.scan / while_loop / cond.
+
+Reference parity: paddle/fluid/operators/{while_op.cc:35, recurrent_op.cc:222,
+conditional_block_op.cc, tensor_array_read_write_op.cc}. The reference runs
+sub-blocks with nested Executors and per-step scopes; here a sub-block is
+traced into the parent's XLA computation as a structured-control-flow region,
+so the whole loop compiles to one fused TPU program (grad flows through via
+jax.vjp of the scan/while, replacing the reference's WhileGrad/RecurrentGrad
+step-scope machinery).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _trace_sub(ctx, block_idx, env):
+    from ..core.executor import trace_block
+    prog = ctx.extra["program"]
+    return trace_block(prog.blocks[block_idx], env, ctx.extra)
+
+
+@register_op("static_rnn")
+def _static_rnn(ctx):
+    """Scan over leading time axis of each step input."""
+    xs = ctx.inputs("X")                 # each [T, ...]
+    mem_init = ctx.inputs("MemInit")
+    step_in = ctx.attr("step_in_names")
+    mem_pre = ctx.attr("mem_pre_names")
+    mem_new = ctx.attr("mem_new_names")
+    out_names = ctx.attr("out_names")
+    blk_idx = ctx.attr("sub_block_idx")
+    outer = dict(ctx.env)
+
+    def body(carry, x_t):
+        env = dict(outer)
+        env.update(zip(mem_pre, carry))
+        env.update(zip(step_in, x_t))
+        env = _trace_sub(ctx, blk_idx, env)
+        new_carry = tuple(env[n] for n in mem_new)
+        outs = tuple(env[n] for n in out_names)
+        return new_carry, outs
+
+    carry0 = tuple(mem_init)
+    _, stacked = jax.lax.scan(body, carry0, tuple(xs))
+    ctx.set_outputs("Out", list(stacked))
+
+
+@register_op("while")
+def _while(ctx):
+    cond_name = ctx.attr("cond_name")
+    carried = ctx.attr("carried_names")
+    blk_idx = ctx.attr("sub_block_idx")
+    outer = dict(ctx.env)
+    cond0 = ctx.input("Cond")
+    init = tuple(outer[n] for n in carried)
+
+    def cond_fn(state):
+        return state[0].reshape(())
+
+    def body_fn(state):
+        vals = state[1:]
+        env = dict(outer)
+        env.update(zip(carried, vals))
+        env = _trace_sub(ctx, blk_idx, env)
+        return (env[cond_name].reshape(()).astype(jnp.bool_),) + \
+            tuple(env[n] for n in carried)
+
+    final = jax.lax.while_loop(
+        cond_fn, body_fn, (cond0.reshape(()).astype(jnp.bool_),) + init)
+    ctx.set_outputs("Out", list(final[1:]))
+
+
+@register_op("cond")
+def _cond(ctx):
+    pred = ctx.input("Pred")
+    outer = dict(ctx.env)
+
+    def make_branch(blk_idx, out_name):
+        def branch(_):
+            env = dict(outer)
+            env = _trace_sub(ctx, blk_idx, env)
+            return env[out_name]
+        return branch
+
+    out = jax.lax.cond(pred.reshape(()).astype(jnp.bool_),
+                       make_branch(ctx.attr("true_block_idx"),
+                                   ctx.attr("true_out")),
+                       make_branch(ctx.attr("false_block_idx"),
+                                   ctx.attr("false_out")),
+                       operand=None)
+    ctx.set_output("Out", out)
+
+
+# -- tensor arrays (dense fixed-capacity form) ------------------------------
+
+@register_op("array_write", no_grad_slots=["I"])
+def _array_write(ctx):
+    x = ctx.input("X")
+    i = ctx.input("I").reshape(()).astype(jnp.int32)
+    arr = ctx.input("Array")
+    if arr is None:
+        cap = ctx.attr("capacity", 128)
+        arr = jnp.zeros((cap,) + tuple(x.shape), x.dtype)
+    out = jax.lax.dynamic_update_index_in_dim(arr, x, i, 0)
+    ctx.set_output("Out", out)
+
+
+@register_op("array_read", no_grad_slots=["I"])
+def _array_read(ctx):
+    arr = ctx.input("Array")
+    i = ctx.input("I").reshape(()).astype(jnp.int32)
+    ctx.set_output("Out", jax.lax.dynamic_index_in_dim(arr, i, 0,
+                                                       keepdims=False))
+
+
+@register_op("array_length", no_grad_slots=["Array"])
+def _array_length(ctx):
+    arr = ctx.input("Array")
+    ctx.set_output("Out", jnp.asarray(arr.shape[0], jnp.int64))

@@ -1,0 +1,236 @@
+"""Core ops: feed/fetch, constants, random init, sum, cast, and the generic
+vjp-based grad op that powers desc-level autodiff.
+
+Reference parity: fill_constant/uniform_random/gaussian_random ops
+(paddle/fluid/operators/fill_constant_op.cc, uniform_random_op.cc,
+gaussian_random_op.cc), sum_op.cc, cast_op.cc, scale_op.cc, assign_op.cc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.ir import OpDesc
+from ..core.lod import RaggedPair
+from ..core.registry import ExecutionContext, OpRegistry, register_op
+
+_JNP_DTYPE = {
+    "float32": jnp.float32, "float64": jnp.float64, "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16, "int8": jnp.int8, "int16": jnp.int16,
+    "int32": jnp.int32, "int64": jnp.int64, "uint8": jnp.uint8,
+    "bool": jnp.bool_,
+}
+
+
+def jnp_dtype(name: str):
+    return _JNP_DTYPE[name]
+
+
+# -- plumbing ---------------------------------------------------------------
+
+@register_op("feed")
+def _feed(ctx):
+    # Feeding is handled by the Executor before tracing; kept for IR parity
+    # with the reference's feed_op (feed_fetch_method.cc).
+    x = ctx.input("X")
+    if x is not None:
+        ctx.set_output("Out", x)
+
+
+@register_op("fetch")
+def _fetch(ctx):
+    x = ctx.input("X")
+    if x is not None:
+        ctx.set_output("Out", x)
+
+
+@register_op("assign")
+def _assign(ctx):
+    ctx.set_output("Out", ctx.input("X"))
+
+
+@register_op("share_data")
+def _share_data(ctx):
+    ctx.set_output("Out", ctx.input("X"))
+
+
+@register_op("print")
+def _print(ctx):
+    # Debug printing inside a jitted graph (reference: print_op.cc).
+    x = ctx.input("X")
+    jax.debug.print(ctx.attr("message", "print_op") + ": {}", x)
+    ctx.set_output("Out", x)
+
+
+# -- constants / random -----------------------------------------------------
+
+@register_op("fill_constant")
+def _fill_constant(ctx):
+    shape = ctx.attr("shape")
+    dtype = jnp_dtype(ctx.attr("dtype", "float32"))
+    value = ctx.attr("value", 0.0)
+    ctx.set_output("Out", jnp.full(shape, value, dtype=dtype))
+
+
+@register_op("fill_constant_like")
+def _fill_constant_like(ctx):
+    x = ctx.input("X")
+    value = ctx.attr("value", 0.0)
+    ctx.set_output("Out", jnp.full(jnp.shape(x), value, dtype=x.dtype))
+
+
+@register_op("fill_constant_batch_size_like", no_grad_slots=["Input"])
+def _fill_constant_batch_size_like(ctx):
+    x = ctx.input("Input")
+    shape = list(ctx.attr("shape"))
+    shape[ctx.attr("output_dim_idx", 0)] = x.shape[ctx.attr("input_dim_idx", 0)]
+    dtype = jnp_dtype(ctx.attr("dtype", "float32"))
+    ctx.set_output("Out", jnp.full(shape, ctx.attr("value", 0.0), dtype=dtype))
+
+
+@register_op("fill_zeros_like")
+def _fill_zeros_like(ctx):
+    ctx.set_output("Out", jnp.zeros_like(ctx.input("X")))
+
+
+def _op_key(ctx):
+    """Deterministic PRNG key for a random op: seed attr folded with step."""
+    seed = ctx.attr("seed", 0) or 0
+    prng = ctx.extra.get("prng")
+    if prng is None:
+        return jax.random.PRNGKey(seed)
+    return prng(seed)
+
+
+@register_op("uniform_random")
+def _uniform_random(ctx):
+    shape = ctx.attr("shape")
+    dtype = jnp_dtype(ctx.attr("dtype", "float32"))
+    lo, hi = ctx.attr("min", -1.0), ctx.attr("max", 1.0)
+    out = jax.random.uniform(_op_key(ctx), tuple(shape), dtype=jnp.float32,
+                             minval=lo, maxval=hi).astype(dtype)
+    ctx.set_output("Out", out)
+
+
+@register_op("gaussian_random")
+def _gaussian_random(ctx):
+    shape = ctx.attr("shape")
+    dtype = jnp_dtype(ctx.attr("dtype", "float32"))
+    mean, std = ctx.attr("mean", 0.0), ctx.attr("std", 1.0)
+    out = mean + std * jax.random.normal(_op_key(ctx), tuple(shape),
+                                         dtype=jnp.float32)
+    ctx.set_output("Out", out.astype(dtype))
+
+
+@register_op("truncated_gaussian_random")
+def _truncated_gaussian_random(ctx):
+    shape = ctx.attr("shape")
+    dtype = jnp_dtype(ctx.attr("dtype", "float32"))
+    mean, std = ctx.attr("mean", 0.0), ctx.attr("std", 1.0)
+    out = mean + std * jax.random.truncated_normal(
+        _op_key(ctx), -2.0, 2.0, tuple(shape), dtype=jnp.float32)
+    ctx.set_output("Out", out.astype(dtype))
+
+
+@register_op("assign_value")
+def _assign_value(ctx):
+    import numpy as _np
+    shape = ctx.attr("shape")
+    dtype = jnp_dtype(ctx.attr("dtype", "float32"))
+    vals = _np.asarray(ctx.attr("values"), dtype=dtype).reshape(shape)
+    ctx.set_output("Out", jnp.asarray(vals))
+
+
+@register_op("randint")
+def _randint(ctx):
+    shape = ctx.attr("shape")
+    dtype = jnp_dtype(ctx.attr("dtype", "int64"))
+    out = jax.random.randint(_op_key(ctx), tuple(shape), ctx.attr("low", 0),
+                             ctx.attr("high", 100), dtype=dtype)
+    ctx.set_output("Out", out)
+
+
+# -- basic transforms -------------------------------------------------------
+
+@register_op("sum")
+def _sum(ctx):
+    xs = ctx.inputs("X")
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    ctx.set_output("Out", out)
+
+
+@register_op("cast")
+def _cast(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", x.astype(jnp_dtype(ctx.attr("out_dtype", "float32"))))
+
+
+@register_op("scale")
+def _scale(ctx):
+    x = ctx.input("X")
+    scale = ctx.attr("scale", 1.0)
+    bias = ctx.attr("bias", 0.0)
+    if ctx.attr("bias_after_scale", True):
+        ctx.set_output("Out", x * scale + bias)
+    else:
+        ctx.set_output("Out", (x + bias) * scale)
+
+
+@register_op("increment")
+def _increment(ctx):
+    ctx.set_output("Out", ctx.input("X") + ctx.attr("step", 1.0))
+
+
+@register_op("shape")
+def _shape(ctx):
+    ctx.set_output("Out", jnp.asarray(jnp.shape(ctx.input("X")),
+                                      dtype=jnp.int64))
+
+
+# -- the generic grad op ----------------------------------------------------
+
+@register_op("__vjp__")
+def _vjp(ctx):
+    """Gradient of an arbitrary forward op via jax.vjp on its compute rule.
+
+    See core/backward.py for how this op is constructed. XLA CSE merges the
+    re-traced forward values with the original forward ops post-fusion.
+    """
+    fwd = OpDesc.from_dict(ctx.attr("fwd_op"))
+    fwd_def = OpRegistry.get(fwd.type)
+    fwd_in_names = fwd.input_names()
+    fwd_out_names = fwd.output_names()
+    in_vals = ctx.inputs("FwdIn")
+    out_grads = ctx.inputs("OutGrad")
+    out_has_grad = ctx.attr("out_has_grad")
+    in_need_grad = ctx.attr("in_need_grad")
+    grad_out_names = [n for n, h in zip(fwd_out_names, out_has_grad) if h]
+
+    # Only grad-receiving outputs go through vjp (others contribute nothing),
+    # and ragged values pass as their dense data (lengths are non-diff ints).
+    def f(vals):
+        env = {}
+        for n, v in zip(fwd_in_names, vals):
+            env[n] = v
+        sub = ExecutionContext(fwd, env, ctx.extra)
+        fwd_def.compute(sub)
+        res = []
+        for n in grad_out_names:
+            v = sub.outputs[n]
+            res.append(v.data if isinstance(v, RaggedPair) else v)
+        return tuple(res)
+
+    _, vjp_fn = jax.vjp(f, tuple(in_vals))
+    cts = tuple(g.data if isinstance(g, RaggedPair) else g for g in out_grads)
+    (in_grads,) = vjp_fn(cts)
+
+    idx = 0
+    for need, g, v in zip(in_need_grad, in_grads, in_vals):
+        if not need:
+            continue
+        if isinstance(g, RaggedPair):
+            g = RaggedPair(g.data, v.lengths)
+        ctx.set_output("InGrad", g, index=idx)
+        idx += 1

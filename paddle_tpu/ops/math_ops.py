@@ -1,0 +1,333 @@
+"""Math ops: elementwise (with reference broadcast semantics), matmul,
+reductions, activations, comparisons, clipping, norms.
+
+Reference parity: paddle/fluid/operators/elementwise_op_function.h (axis
+broadcast), matmul_op.cc, mul_op.cc (flatten-to-2D matmul), reduce_op.cc,
+activation_op.cc, clip_op.cc, softmax_op.cc, topk. All rules are pure
+jax.numpy, so the MXU sees large fused matmuls and XLA fuses the rest.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .core_ops import jnp_dtype
+
+
+def _broadcast_y(x, y, axis: int):
+    """Reference elementwise broadcast: align y's dims starting at `axis`
+    of x (elementwise_op_function.h). axis=-1 means trailing alignment."""
+    xnd, ynd = x.ndim, y.ndim
+    if xnd == ynd:
+        return y
+    if axis == -1 or axis is None:
+        axis = xnd - ynd
+    shape = [1] * axis + list(y.shape) + [1] * (xnd - axis - ynd)
+    return y.reshape(shape)
+
+
+def _register_elementwise(name, fn):
+    @register_op(name)
+    def _op(ctx, _fn=fn):
+        x = ctx.input("X")
+        y = ctx.input("Y")
+        y = _broadcast_y(x, y, ctx.attr("axis", -1))
+        ctx.set_output("Out", _fn(x, y))
+
+
+_register_elementwise("elementwise_add", lambda x, y: x + y)
+_register_elementwise("elementwise_sub", lambda x, y: x - y)
+_register_elementwise("elementwise_mul", lambda x, y: x * y)
+_register_elementwise("elementwise_div", lambda x, y: x / y)
+_register_elementwise("elementwise_pow", lambda x, y: jnp.power(x, y))
+_register_elementwise("elementwise_max", jnp.maximum)
+_register_elementwise("elementwise_min", jnp.minimum)
+_register_elementwise("elementwise_mod", jnp.mod)
+_register_elementwise("elementwise_floordiv", jnp.floor_divide)
+
+
+@register_op("mul")
+def _mul(ctx):
+    """The reference's `mul` op: flatten X to 2-D at x_num_col_dims, Y at
+    y_num_col_dims, matmul, restore shape (mul_op.cc)."""
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    xn = ctx.attr("x_num_col_dims", 1)
+    yn = ctx.attr("y_num_col_dims", 1)
+    x2 = x.reshape((_prod(x.shape[:xn]), _prod(x.shape[xn:])))
+    y2 = y.reshape((_prod(y.shape[:yn]), _prod(y.shape[yn:])))
+    out = x2 @ y2
+    out_shape = tuple(x.shape[:xn]) + tuple(y.shape[yn:])
+    ctx.set_output("Out", out.reshape(out_shape))
+
+
+def _prod(dims):
+    p = 1
+    for d in dims:
+        p *= int(d)
+    return p
+
+
+@register_op("matmul")
+def _matmul(ctx):
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    if ctx.attr("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if ctx.attr("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = ctx.attr("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    ctx.set_output("Out", out)
+
+
+@register_op("dot")
+def _dot(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    ctx.set_output("Out", jnp.sum(x * y, axis=-1, keepdims=True))
+
+
+# -- reductions -------------------------------------------------------------
+
+def _register_reduce(name, fn):
+    @register_op(name)
+    def _op(ctx, _fn=fn):
+        x = ctx.input("X")
+        dim = ctx.attr("dim", None)
+        keep = ctx.attr("keep_dim", False)
+        if ctx.attr("reduce_all", False) or dim is None:
+            axis = None
+        else:
+            axis = tuple(dim) if isinstance(dim, (list, tuple)) else (dim,)
+        out = _fn(x, axis=axis, keepdims=keep)
+        if axis is None and not keep:
+            out = out.reshape(())
+        ctx.set_output("Out", out)
+
+
+_register_reduce("reduce_sum", jnp.sum)
+_register_reduce("reduce_mean", jnp.mean)
+_register_reduce("reduce_max", jnp.max)
+_register_reduce("reduce_min", jnp.min)
+_register_reduce("reduce_prod", jnp.prod)
+
+
+@register_op("mean")
+def _mean(ctx):
+    ctx.set_output("Out", jnp.mean(ctx.input("X")))
+
+
+# -- activations ------------------------------------------------------------
+
+def _register_act(name, fn):
+    @register_op(name)
+    def _op(ctx, _fn=fn):
+        ctx.set_output("Out", _fn(ctx.input("X")))
+
+
+_register_act("relu", jax.nn.relu)
+_register_act("relu6", lambda x: jnp.clip(x, 0.0, 6.0))
+_register_act("sigmoid", jax.nn.sigmoid)
+_register_act("logsigmoid", jax.nn.log_sigmoid)
+_register_act("tanh", jnp.tanh)
+_register_act("tanh_shrink", lambda x: x - jnp.tanh(x))
+_register_act("softsign", lambda x: x / (1 + jnp.abs(x)))
+_register_act("sqrt", jnp.sqrt)
+_register_act("rsqrt", jax.lax.rsqrt)
+_register_act("abs", jnp.abs)
+_register_act("ceil", jnp.ceil)
+_register_act("floor", jnp.floor)
+_register_act("round", jnp.round)
+_register_act("reciprocal", lambda x: 1.0 / x)
+_register_act("square", jnp.square)
+_register_act("exp", jnp.exp)
+_register_act("log", jnp.log)
+_register_act("gelu", jax.nn.gelu)
+_register_act("sin", jnp.sin)
+_register_act("cos", jnp.cos)
+_register_act("sign", jnp.sign)
+
+
+@register_op("softplus")
+def _softplus(ctx):
+    ctx.set_output("Out", jax.nn.softplus(ctx.input("X")))
+
+
+@register_op("leaky_relu")
+def _leaky_relu(ctx):
+    alpha = ctx.attr("alpha", 0.02)
+    x = ctx.input("X")
+    ctx.set_output("Out", jnp.where(x >= 0, x, alpha * x))
+
+
+@register_op("elu")
+def _elu(ctx):
+    ctx.set_output("Out", jax.nn.elu(ctx.input("X"), ctx.attr("alpha", 1.0)))
+
+
+@register_op("pow")
+def _pow(ctx):
+    ctx.set_output("Out", jnp.power(ctx.input("X"), ctx.attr("factor", 1.0)))
+
+
+@register_op("hard_sigmoid")
+def _hard_sigmoid(ctx):
+    slope = ctx.attr("slope", 0.2)
+    offset = ctx.attr("offset", 0.5)
+    ctx.set_output("Out", jnp.clip(slope * ctx.input("X") + offset, 0.0, 1.0))
+
+
+@register_op("swish")
+def _swish(ctx):
+    beta = ctx.attr("beta", 1.0)
+    x = ctx.input("X")
+    ctx.set_output("Out", x * jax.nn.sigmoid(beta * x))
+
+
+@register_op("soft_relu")
+def _soft_relu(ctx):
+    t = ctx.attr("threshold", 40.0)
+    x = jnp.clip(ctx.input("X"), -t, t)
+    ctx.set_output("Out", jnp.log(1 + jnp.exp(x)))
+
+
+@register_op("clip")
+def _clip(ctx):
+    ctx.set_output("Out", jnp.clip(ctx.input("X"), ctx.attr("min", -1.0),
+                                   ctx.attr("max", 1.0)))
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx):
+    x = ctx.input("X")
+    max_norm = ctx.attr("max_norm", 1.0)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.minimum(max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    ctx.set_output("Out", x * scale)
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx):
+    ctx.set_output("Out", jnp.sum(jnp.square(ctx.input("X"))).reshape(()))
+
+
+@register_op("l2_normalize")
+def _l2_normalize(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    eps = ctx.attr("epsilon", 1e-12)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    ctx.set_output("Out", x / jnp.maximum(norm, eps))
+    ctx.set_output("Norm", norm)
+
+
+# -- softmax family ---------------------------------------------------------
+
+@register_op("softmax")
+def _softmax(ctx):
+    ctx.set_output("Out", jax.nn.softmax(ctx.input("X"),
+                                         axis=ctx.attr("axis", -1)))
+
+
+@register_op("log_softmax")
+def _log_softmax(ctx):
+    ctx.set_output("Out", jax.nn.log_softmax(ctx.input("X"),
+                                             axis=ctx.attr("axis", -1)))
+
+
+# -- comparisons / logical --------------------------------------------------
+
+def _register_cmp(name, fn):
+    @register_op(name, no_grad_slots=["X", "Y"])
+    def _op(ctx, _fn=fn):
+        x, y = ctx.input("X"), ctx.input("Y")
+        if y is not None:
+            y = _broadcast_y(x, y, ctx.attr("axis", -1))
+        ctx.set_output("Out", _fn(x, y))
+
+
+_register_cmp("equal", lambda x, y: x == y)
+_register_cmp("not_equal", lambda x, y: x != y)
+_register_cmp("less_than", lambda x, y: x < y)
+_register_cmp("less_equal", lambda x, y: x <= y)
+_register_cmp("greater_than", lambda x, y: x > y)
+_register_cmp("greater_equal", lambda x, y: x >= y)
+
+_register_cmp("logical_and", jnp.logical_and)
+_register_cmp("logical_or", jnp.logical_or)
+_register_cmp("logical_xor", jnp.logical_xor)
+
+
+@register_op("logical_not", no_grad_slots=["X"])
+def _logical_not(ctx):
+    ctx.set_output("Out", jnp.logical_not(ctx.input("X")))
+
+
+@register_op("isfinite", no_grad_slots=["X"])
+def _isfinite(ctx):
+    ctx.set_output("Out", jnp.all(jnp.isfinite(ctx.input("X"))).reshape(()))
+
+
+# -- misc math --------------------------------------------------------------
+
+@register_op("top_k", no_grad_slots=["X"])
+def _top_k(ctx):
+    x = ctx.input("X")
+    k = ctx.attr("k", 1)
+    vals, idxs = jax.lax.top_k(x, k)
+    ctx.set_output("Out", vals)
+    ctx.set_output("Indices", idxs.astype(jnp.int64))
+
+
+@register_op("arg_max", no_grad_slots=["X"])
+def _arg_max(ctx):
+    ctx.set_output("Out", jnp.argmax(ctx.input("X"),
+                                     axis=ctx.attr("axis", -1)).astype(jnp.int64))
+
+
+@register_op("arg_min", no_grad_slots=["X"])
+def _arg_min(ctx):
+    ctx.set_output("Out", jnp.argmin(ctx.input("X"),
+                                     axis=ctx.attr("axis", -1)).astype(jnp.int64))
+
+
+@register_op("cumsum")
+def _cumsum(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    reverse = ctx.attr("reverse", False)
+    exclusive = ctx.attr("exclusive", False)
+    work = jnp.flip(x, axis) if reverse else x
+    out = jnp.cumsum(work, axis=axis)
+    if exclusive:
+        # shift forward along axis: out[i] = sum of strictly-earlier elems
+        pad = [(0, 0)] * x.ndim
+        pad[axis % x.ndim] = (1, 0)
+        out = jnp.pad(out, pad)[tuple(
+            slice(0, s) if i == (axis % x.ndim) else slice(None)
+            for i, s in enumerate(x.shape))]
+    if reverse:
+        out = jnp.flip(out, axis)
+    ctx.set_output("Out", out)
+
+
+@register_op("maxout")
+def _maxout(ctx):
+    x = ctx.input("X")  # NCHW
+    groups = ctx.attr("groups")
+    n, c, h, w = x.shape
+    ctx.set_output("Out", x.reshape(n, c // groups, groups, h, w).max(axis=2))
+
+
+@register_op("cos_sim")
+def _cos_sim(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), -1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), -1, keepdims=True))
+    out = jnp.sum(x * y, -1, keepdims=True) / (xn * yn + 1e-12)
+    ctx.set_output("Out", out)
+    ctx.set_output("XNorm", xn)
+    ctx.set_output("YNorm", yn)

@@ -1,0 +1,129 @@
+"""Metric ops: accuracy, auc, precision/recall — in-graph metrics as in the
+reference (paddle/fluid/operators/{accuracy_op.cc, auc_op.cc,
+precision_recall_op.cc}).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("accuracy", no_grad_slots=["Out", "Indices", "Label"])
+def _accuracy(ctx):
+    """Top-k accuracy. Inputs: Out (topk values), Indices (topk indices),
+    Label [N, 1]."""
+    indices = ctx.input("Indices")
+    label = ctx.input("Label")
+    lab = label.reshape(-1, 1).astype(indices.dtype)
+    correct = jnp.any(indices == lab, axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    total = indices.shape[0]
+    ctx.set_output("Accuracy",
+                   (num_correct.astype(jnp.float32) / total).reshape(()))
+    ctx.set_output("Correct", num_correct.reshape(()))
+    ctx.set_output("Total", jnp.asarray(total, jnp.int32).reshape(()))
+
+
+@register_op("auc", no_grad_slots=["Predict", "Label"])
+def _auc(ctx):
+    """Threshold-bucketed AUC (single-batch; streaming accumulation is done
+    by the python Evaluator as in the reference's stat vars)."""
+    predict = ctx.input("Predict")  # [N, 2] or [N, 1] prob of positive
+    label = ctx.input("Label").reshape(-1)
+    pos_prob = predict[:, -1]
+    num_thresholds = ctx.attr("num_thresholds", 200)
+    thresholds = jnp.linspace(0.0, 1.0, num_thresholds)
+    pos = (label > 0)[None, :]
+    pred_pos = pos_prob[None, :] >= thresholds[:, None]
+    tp = jnp.sum(pred_pos & pos, axis=1).astype(jnp.float32)
+    fp = jnp.sum(pred_pos & ~pos, axis=1).astype(jnp.float32)
+    fn = jnp.sum(~pred_pos & pos, axis=1).astype(jnp.float32)
+    tn = jnp.sum(~pred_pos & ~pos, axis=1).astype(jnp.float32)
+    tpr = tp / jnp.maximum(tp + fn, 1e-12)
+    fpr = fp / jnp.maximum(fp + tn, 1e-12)
+    # trapezoidal area over the (sorted by fpr) curve
+    order = jnp.argsort(fpr)
+    fpr_s = fpr[order]
+    tpr_s = tpr[order]
+    auc = jnp.sum((fpr_s[1:] - fpr_s[:-1]) * (tpr_s[1:] + tpr_s[:-1]) / 2.0)
+    ctx.set_output("AUC", auc.reshape(()))
+    ctx.set_output("TPOut", tp)
+    ctx.set_output("FPOut", fp)
+    ctx.set_output("TNOut", tn)
+    ctx.set_output("FNOut", fn)
+
+
+@register_op("precision_recall", no_grad_slots=["MaxProbs", "Indices",
+                                                "Labels", "Weights"])
+def _precision_recall(ctx):
+    indices = ctx.input("Indices").reshape(-1)
+    labels = ctx.input("Labels").reshape(-1)
+    num_classes = ctx.attr("class_number")
+    pred = indices.astype(jnp.int32)
+    lab = labels.astype(jnp.int32)
+    onehot_p = jax.nn.one_hot(pred, num_classes)
+    onehot_l = jax.nn.one_hot(lab, num_classes)
+    tp = jnp.sum(onehot_p * onehot_l, axis=0)
+    fp = jnp.sum(onehot_p * (1 - onehot_l), axis=0)
+    fn = jnp.sum((1 - onehot_p) * onehot_l, axis=0)
+    precision = tp / jnp.maximum(tp + fp, 1e-12)
+    recall = tp / jnp.maximum(tp + fn, 1e-12)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    macro = jnp.stack([precision.mean(), recall.mean(), f1.mean()])
+    tp_a, fp_a, fn_a = tp.sum(), fp.sum(), fn.sum()
+    micro_p = tp_a / jnp.maximum(tp_a + fp_a, 1e-12)
+    micro_r = tp_a / jnp.maximum(tp_a + fn_a, 1e-12)
+    micro_f = 2 * micro_p * micro_r / jnp.maximum(micro_p + micro_r, 1e-12)
+    metrics = jnp.concatenate([macro, jnp.stack([micro_p, micro_r, micro_f])])
+    ctx.set_output("Metrics", metrics)
+    ctx.set_output("BatchMetrics", metrics)
+    ctx.set_output("AccumMetrics", metrics)
+
+
+@register_op("edit_distance", no_grad_slots=["Hyps", "Refs"])
+def _edit_distance(ctx):
+    """Levenshtein distance between ragged hypothesis/reference int
+    sequences (reference: edit_distance_op.cu) via a dense DP in-graph."""
+    from ..core.lod import RaggedPair
+
+    hyps = ctx.input("Hyps")
+    refs = ctx.input("Refs")
+    h = hyps if isinstance(hyps, RaggedPair) else RaggedPair(
+        hyps, jnp.full((hyps.shape[0],), hyps.shape[1], jnp.int32))
+    r = refs if isinstance(refs, RaggedPair) else RaggedPair(
+        refs, jnp.full((refs.shape[0],), refs.shape[1], jnp.int32))
+    hd = h.data.reshape(h.data.shape[0], -1)
+    rd = r.data.reshape(r.data.shape[0], -1)
+    m, n = hd.shape[1], rd.shape[1]
+
+    def per_pair(hrow, hlen, rrow, rlen):
+        big = jnp.asarray(10**6, jnp.float32)
+        row0 = jnp.arange(n + 1, dtype=jnp.float32)
+        row0 = jnp.where(jnp.arange(n + 1) <= rlen, row0, big)
+
+        def outer(i, row):
+            ins_cost = jnp.where(i < hlen + 1, i + 0.0, big)
+
+            def inner(j, carry):
+                row_new, prev_diag = carry
+                sub = prev_diag + (hrow[i - 1] != rrow[j - 1])
+                val = jnp.minimum(jnp.minimum(row[j] + 1,
+                                              row_new[j - 1] + 1), sub)
+                val = jnp.where((i <= hlen) & (j <= rlen), val, big)
+                return row_new.at[j].set(val), row[j]
+
+            row_new = jnp.full((n + 1,), big).at[0].set(ins_cost)
+            row_new, _ = jax.lax.fori_loop(
+                1, n + 1, inner, (row_new, row[0]))
+            return row_new
+
+        final = jax.lax.fori_loop(1, m + 1, outer, row0)
+        return final[rlen.astype(jnp.int32)]
+
+    dist = jax.vmap(per_pair)(hd, h.lengths, rd, r.lengths)
+    if ctx.attr("normalized", False):
+        dist = dist / jnp.maximum(r.lengths.astype(jnp.float32), 1.0)
+    ctx.set_output("Out", dist.reshape(-1, 1))
+    ctx.set_output("SequenceNum", jnp.asarray(hd.shape[0], jnp.int64))

@@ -1,0 +1,468 @@
+"""Neural-net ops: conv, pool, normalization, dropout, losses, embeddings.
+
+Reference parity: paddle/fluid/operators/{conv_op.cc, pool_op.cc,
+batch_norm_op.cc, layer_norm_op.cc, dropout_op.cc, cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, lookup_table_op.cc, one_hot_op.cc,
+smooth_l1_loss_op.cc, huber_loss_op.cc, hinge_loss_op.cc, nce_op.cc...}.
+Layout follows the reference's NCHW API; XLA's layout assignment re-tiles
+for the MXU internally, so parity costs nothing on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .core_ops import jnp_dtype, _op_key
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+# -- convolution ------------------------------------------------------------
+
+def _conv2d_impl(x, w, strides, paddings, dilations, groups):
+    return jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    ).astype(x.dtype)
+
+
+@register_op("conv2d")
+def _conv2d(ctx):
+    x = ctx.input("Input")
+    w = ctx.input("Filter")
+    out = _conv2d_impl(x, w, _pair(ctx.attr("strides", [1, 1])),
+                       _pair(ctx.attr("paddings", [0, 0])),
+                       _pair(ctx.attr("dilations", [1, 1])),
+                       ctx.attr("groups", 1))
+    ctx.set_output("Output", out)
+
+
+@register_op("depthwise_conv2d")
+def _depthwise_conv2d(ctx):
+    x = ctx.input("Input")
+    w = ctx.input("Filter")
+    groups = x.shape[1]
+    out = _conv2d_impl(x, w, _pair(ctx.attr("strides", [1, 1])),
+                       _pair(ctx.attr("paddings", [0, 0])),
+                       _pair(ctx.attr("dilations", [1, 1])), groups)
+    ctx.set_output("Output", out)
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx):
+    x = ctx.input("Input")
+    w = ctx.input("Filter")  # [in_c, out_c, kh, kw]
+    s = _pair(ctx.attr("strides", [1, 1]))
+    p = _pair(ctx.attr("paddings", [0, 0]))
+    d = _pair(ctx.attr("dilations", [1, 1]))
+    out = jax.lax.conv_transpose(
+        x, w, strides=s,
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=d,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True)
+    ctx.set_output("Output", out)
+
+
+@register_op("conv3d")
+def _conv3d(ctx):
+    x = ctx.input("Input")
+    w = ctx.input("Filter")
+    s = ctx.attr("strides", [1, 1, 1])
+    p = ctx.attr("paddings", [0, 0, 0])
+    d = ctx.attr("dilations", [1, 1, 1])
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=tuple(s),
+        padding=[(p[0], p[0]), (p[1], p[1]), (p[2], p[2])],
+        rhs_dilation=tuple(d),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=ctx.attr("groups", 1))
+    ctx.set_output("Output", out)
+
+
+# -- pooling ----------------------------------------------------------------
+
+@register_op("pool2d")
+def _pool2d(ctx):
+    x = ctx.input("X")
+    ptype = ctx.attr("pooling_type", "max")
+    k = _pair(ctx.attr("ksize", [2, 2]))
+    s = _pair(ctx.attr("strides", [2, 2]))
+    p = _pair(ctx.attr("paddings", [0, 0]))
+    if ctx.attr("global_pooling", False):
+        k = (x.shape[2], x.shape[3])
+        s = k
+        p = (0, 0)
+    dims = (1, 1) + k
+    strides = (1, 1) + s
+    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    if ptype == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, dims, strides, pads)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides,
+                                       pads)
+        if ctx.attr("exclusive", True) and (p[0] or p[1]):
+            ones = jnp.ones_like(x)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
+                                           strides, pads)
+            out = summed / counts
+        else:
+            out = summed / (k[0] * k[1])
+    ctx.set_output("Out", out)
+
+
+@register_op("adaptive_pool2d")
+def _adaptive_pool2d(ctx):
+    x = ctx.input("X")
+    oh, ow = _pair(ctx.attr("pool_size", [1, 1]))
+    n, c, h, w = x.shape
+    assert h % oh == 0 and w % ow == 0, "adaptive pool needs divisible sizes"
+    xr = x.reshape(n, c, oh, h // oh, ow, w // ow)
+    if ctx.attr("pooling_type", "avg") == "max":
+        out = xr.max(axis=(3, 5))
+    else:
+        out = xr.mean(axis=(3, 5))
+    ctx.set_output("Out", out)
+
+
+# -- normalization ----------------------------------------------------------
+
+@register_op("batch_norm")
+def _batch_norm(ctx):
+    """Inputs: X, Scale, Bias, Mean, Variance. Outputs: Y, MeanOut,
+    VarianceOut, SavedMean, SavedVariance (reference: batch_norm_op.cc)."""
+    x = ctx.input("X")
+    scale = ctx.input("Scale")
+    bias = ctx.input("Bias")
+    mean_in = ctx.input("Mean")
+    var_in = ctx.input("Variance")
+    eps = ctx.attr("epsilon", 1e-5)
+    momentum = ctx.attr("momentum", 0.9)
+    is_test = ctx.attr("is_test", False)
+
+    ch_axis = 1 if ctx.attr("data_layout", "NCHW") == "NCHW" else x.ndim - 1
+    red_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+
+    if is_test:
+        mean, var = mean_in, var_in
+        saved_mean, saved_var = mean_in, var_in
+        mean_out, var_out = mean_in, var_in
+    else:
+        # Compute batch stats in f32 for stability under bf16 activations.
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=red_axes)
+        var = jnp.var(xf, axis=red_axes)
+        mean_out = mean_in * momentum + mean * (1 - momentum)
+        var_out = var_in * momentum + var * (1 - momentum)
+        saved_mean = mean
+        saved_var = 1.0 / jnp.sqrt(var + eps)
+
+    inv = (1.0 / jnp.sqrt(var.astype(jnp.float32) + eps)).reshape(bshape)
+    y = (x.astype(jnp.float32) - mean.reshape(bshape)) * inv
+    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    ctx.set_output("Y", y.astype(x.dtype))
+    ctx.set_output("MeanOut", mean_out)
+    ctx.set_output("VarianceOut", var_out)
+    ctx.set_output("SavedMean", saved_mean)
+    ctx.set_output("SavedVariance", saved_var)
+
+
+@register_op("layer_norm")
+def _layer_norm(ctx):
+    x = ctx.input("X")
+    scale = ctx.input("Scale")
+    bias = ctx.input("Bias")
+    eps = ctx.attr("epsilon", 1e-5)
+    begin = ctx.attr("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) / jnp.sqrt(var + eps)
+    norm_shape = (1,) * begin + x.shape[begin:]
+    if scale is not None:
+        y = y * scale.reshape(norm_shape)
+    if bias is not None:
+        y = y + bias.reshape(norm_shape)
+    ctx.set_output("Y", y.astype(x.dtype))
+    ctx.set_output("Mean", mean.reshape(x.shape[:begin]))
+    ctx.set_output("Variance", var.reshape(x.shape[:begin]))
+
+
+@register_op("lrn")
+def _lrn(ctx):
+    x = ctx.input("X")  # NCHW
+    n = ctx.attr("n", 5)
+    k = ctx.attr("k", 2.0)
+    alpha = ctx.attr("alpha", 1e-4)
+    beta = ctx.attr("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    ctx.set_output("Out", x / jnp.power(k + alpha * acc, beta))
+    ctx.set_output("MidOut", k + alpha * acc)
+
+
+# -- dropout ----------------------------------------------------------------
+
+@register_op("dropout")
+def _dropout(ctx):
+    x = ctx.input("X")
+    prob = ctx.attr("dropout_prob", 0.5)
+    if ctx.attr("is_test", False) or prob == 0.0:
+        ctx.set_output("Out", x)
+        ctx.set_output("Mask", jnp.ones_like(x))
+        return
+    keep = 1.0 - prob
+    mask = jax.random.bernoulli(_op_key(ctx), keep, x.shape)
+    impl = ctx.attr("dropout_implementation", "downgrade_in_infer")
+    if impl == "upscale_in_train":
+        out = jnp.where(mask, x / keep, 0.0)
+    else:  # reference default: scale at inference instead
+        out = jnp.where(mask, x, 0.0)
+    ctx.set_output("Out", out.astype(x.dtype))
+    ctx.set_output("Mask", mask.astype(x.dtype))
+
+
+# -- losses -----------------------------------------------------------------
+
+@register_op("cross_entropy", no_grad_slots=["Label"])
+def _cross_entropy(ctx):
+    x = ctx.input("X")  # probabilities [N, C] (post-softmax)
+    label = ctx.input("Label")
+    eps = 1e-8
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        lab = label.reshape(label.shape[:-1]) if label.shape[-1] == 1 \
+            else label
+        picked = jnp.take_along_axis(
+            x, lab[..., None].astype(jnp.int32), axis=-1)
+        loss = -jnp.log(picked + eps)
+    ctx.set_output("Y", loss)
+
+
+@register_op("softmax_with_cross_entropy", no_grad_slots=["Label"])
+def _softmax_with_cross_entropy(ctx):
+    logits = ctx.input("Logits")
+    label = ctx.input("Label")
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        lab = label.reshape(label.shape[:-1]) if label.shape[-1] == 1 \
+            else label
+        picked = jnp.take_along_axis(
+            logp, lab[..., None].astype(jnp.int32), axis=-1)
+        loss = -picked
+    ctx.set_output("Softmax", jnp.exp(logp))
+    ctx.set_output("Loss", loss)
+
+
+@register_op("sigmoid_cross_entropy_with_logits", no_grad_slots=["Label"])
+def _sigmoid_xent(ctx):
+    x = ctx.input("X")
+    label = ctx.input("Label")
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ctx.set_output("Out", loss)
+
+
+@register_op("square_error_cost")
+def _square_error_cost(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    ctx.set_output("Out", jnp.square(x - y))
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    sigma = ctx.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    ad = jnp.abs(diff)
+    elem = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff, ad - 0.5 / s2)
+    ctx.set_output("Diff", diff)
+    ctx.set_output("Out", jnp.sum(elem, axis=tuple(range(1, x.ndim)),
+                                  keepdims=False).reshape(x.shape[0], 1))
+
+
+@register_op("huber_loss")
+def _huber_loss(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    delta = ctx.attr("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r,
+                     delta * (ar - 0.5 * delta))
+    ctx.set_output("Residual", r)
+    ctx.set_output("Out", loss)
+
+
+@register_op("hinge_loss", no_grad_slots=["Labels"])
+def _hinge_loss(ctx):
+    logits = ctx.input("Logits")
+    labels = ctx.input("Labels")
+    ctx.set_output("Loss",
+                   jnp.maximum(1.0 - (2.0 * labels - 1.0) * logits, 0.0))
+
+
+@register_op("log_loss", no_grad_slots=["Labels"])
+def _log_loss(ctx):
+    pred = ctx.input("Predicted")
+    label = ctx.input("Labels")
+    eps = ctx.attr("epsilon", 1e-4)
+    loss = -label * jnp.log(pred + eps) \
+        - (1.0 - label) * jnp.log(1.0 - pred + eps)
+    ctx.set_output("Loss", loss)
+
+
+@register_op("margin_rank_loss", no_grad_slots=["Label"])
+def _margin_rank_loss(ctx):
+    x1, x2 = ctx.input("X1"), ctx.input("X2")
+    label = ctx.input("Label")
+    margin = ctx.attr("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    ctx.set_output("Out", out)
+    ctx.set_output("Activated", (out > 0).astype(x1.dtype))
+
+
+@register_op("kldiv_loss", no_grad_slots=["Target"])
+def _kldiv_loss(ctx):
+    x = ctx.input("X")  # log-probabilities
+    target = ctx.input("Target")
+    loss = target * (jnp.log(jnp.maximum(target, 1e-12)) - x)
+    red = ctx.attr("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    ctx.set_output("Loss", loss)
+
+
+# -- embeddings -------------------------------------------------------------
+
+@register_op("lookup_table", no_grad_slots=["Ids"])
+def _lookup_table(ctx):
+    """Embedding lookup (reference: lookup_table_op.cc). Ids may carry a
+    trailing [.., 1] dim like the reference's LoDTensor ids."""
+    w = ctx.input("W")
+    ids = ctx.input("Ids")
+    if ids.shape and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    padding_idx = ctx.attr("padding_idx", -1)
+    out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    ctx.set_output("Out", out)
+
+
+@register_op("one_hot", no_grad_slots=["X"])
+def _one_hot(ctx):
+    x = ctx.input("X")
+    depth = ctx.attr("depth")
+    if x.shape and x.shape[-1] == 1:
+        x = x.reshape(x.shape[:-1])
+    ctx.set_output("Out", jax.nn.one_hot(x.astype(jnp.int32), depth,
+                                         dtype=jnp.float32))
+
+
+@register_op("embedding_bag", no_grad_slots=["Ids"])
+def _embedding_bag(ctx):
+    w = ctx.input("W")
+    ids = ctx.input("Ids")  # [batch, bag]
+    emb = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    mode = ctx.attr("mode", "sum")
+    out = emb.sum(axis=1) if mode == "sum" else emb.mean(axis=1)
+    ctx.set_output("Out", out)
+
+
+# -- attention / transformer helpers ---------------------------------------
+
+@register_op("stack")
+def _stack(ctx):
+    xs = ctx.inputs("X")
+    ctx.set_output("Y", jnp.stack(xs, axis=ctx.attr("axis", 0)))
+
+
+@register_op("unstack")
+def _unstack(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 0)
+    num = x.shape[axis]
+    parts = jnp.split(x, num, axis=axis)
+    ctx.set_outputs("Y", [p.squeeze(axis) for p in parts])
+
+
+@register_op("scaled_dot_product_attention")
+def _sdpa(ctx):
+    """Fused attention (TPU-native addition; the reference composes it from
+    matmul/softmax in python/paddle/fluid/nets.py:312)."""
+    q, k, v = ctx.input("Q"), ctx.input("K"), ctx.input("V")
+    mask = ctx.input("Mask")
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    if mask is not None:
+        scores = scores + mask
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx.set_output("Out", jnp.einsum("...qk,...kd->...qd", probs, v))
+
+
+# -- misc -------------------------------------------------------------------
+
+@register_op("nce", no_grad_slots=["Label", "SampleWeight"])
+def _nce(ctx):
+    """Noise-contrastive estimation loss (reference: nce_op.cc), with
+    deterministic uniform sampling of negatives."""
+    x = ctx.input("Input")            # [N, D]
+    label = ctx.input("Label")        # [N, 1] int
+    w = ctx.input("Weight")           # [V, D]
+    b = ctx.input("Bias")             # [V]
+    num_neg = ctx.attr("num_neg_samples", 10)
+    num_total = w.shape[0]
+    key = _op_key(ctx)
+    neg = jax.random.randint(key, (x.shape[0], num_neg), 0, num_total)
+    lab = label.reshape(-1).astype(jnp.int32)
+
+    def logit(ids):
+        ww = jnp.take(w, ids, axis=0)       # [..., D]
+        bb = jnp.take(b, ids, axis=0) if b is not None else 0.0
+        return jnp.einsum("nd,n...d->n...", x, ww) + bb
+
+    pos_logit = logit(lab[:, None]).reshape(-1)      # [N]
+    neg_logit = logit(neg)                           # [N, num_neg]
+    pos_loss = jax.nn.softplus(-pos_logit)
+    neg_loss = jax.nn.softplus(neg_logit).sum(axis=1)
+    ctx.set_output("Cost", (pos_loss + neg_loss).reshape(-1, 1))
+
+
+@register_op("im2sequence", no_grad_slots=[])
+def _im2sequence(ctx):
+    x = ctx.input("X")  # NCHW
+    kh, kw = _pair(ctx.attr("kernels", [1, 1]))
+    sh, sw = _pair(ctx.attr("strides", [1, 1]))
+    n, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))  # [n, c*kh*kw, oh, ow]
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    ctx.set_output("Out", out)

@@ -1,0 +1,190 @@
+"""Optimizer ops: one op per parameter update, writing ParamOut (and
+accumulator outs) back to persistable state — the executor threads them
+functionally with buffer donation, so updates stay on-device in place.
+
+Reference parity: paddle/fluid/operators/{sgd_op.cc, momentum_op.cc,
+adam_op.cc, adagrad_op.cc, adamax_op.cc, adadelta_op.cc, rmsprop_op.cc,
+decayed_adagrad_op.cc, ftrl_op.cc, lars_momentum...}. All rules are pure
+jnp; optimizer math runs fused into the training step's XLA program.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _no_grads(*slots):
+    return list(slots)
+
+
+@register_op("sgd", no_grad_slots=["Param", "Grad", "LearningRate"])
+def _sgd(ctx):
+    p = ctx.input("Param")
+    g = ctx.input("Grad")
+    lr = ctx.input("LearningRate")
+    ctx.set_output("ParamOut", p - lr.reshape(()).astype(p.dtype) * g)
+
+
+@register_op("momentum",
+             no_grad_slots=["Param", "Grad", "Velocity", "LearningRate"])
+def _momentum(ctx):
+    p = ctx.input("Param")
+    g = ctx.input("Grad")
+    v = ctx.input("Velocity")
+    lr = ctx.input("LearningRate").reshape(())
+    mu = ctx.attr("mu", 0.9)
+    v_out = mu * v + g
+    if ctx.attr("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    ctx.set_output("ParamOut", p_out)
+    ctx.set_output("VelocityOut", v_out)
+
+
+@register_op("adam", no_grad_slots=[
+    "Param", "Grad", "Moment1", "Moment2", "LearningRate",
+    "Beta1Pow", "Beta2Pow"])
+def _adam(ctx):
+    p = ctx.input("Param")
+    g = ctx.input("Grad")
+    m1 = ctx.input("Moment1")
+    m2 = ctx.input("Moment2")
+    lr = ctx.input("LearningRate").reshape(())
+    b1p = ctx.input("Beta1Pow").reshape(())
+    b2p = ctx.input("Beta2Pow").reshape(())
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    m1_out = b1 * m1 + (1 - b1) * g
+    m2_out = b2 * m2 + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_out = p - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
+    ctx.set_output("ParamOut", p_out)
+    ctx.set_output("Moment1Out", m1_out)
+    ctx.set_output("Moment2Out", m2_out)
+    ctx.set_output("Beta1PowOut", b1p * b1)
+    ctx.set_output("Beta2PowOut", b2p * b2)
+
+
+@register_op("adagrad", no_grad_slots=["Param", "Grad", "Moment",
+                                       "LearningRate"])
+def _adagrad(ctx):
+    p = ctx.input("Param")
+    g = ctx.input("Grad")
+    m = ctx.input("Moment")
+    lr = ctx.input("LearningRate").reshape(())
+    eps = ctx.attr("epsilon", 1e-6)
+    m_out = m + jnp.square(g)
+    ctx.set_output("ParamOut", p - lr * g / (jnp.sqrt(m_out) + eps))
+    ctx.set_output("MomentOut", m_out)
+
+
+@register_op("adamax", no_grad_slots=["Param", "Grad", "Moment", "InfNorm",
+                                      "LearningRate", "Beta1Pow"])
+def _adamax(ctx):
+    p = ctx.input("Param")
+    g = ctx.input("Grad")
+    m = ctx.input("Moment")
+    u = ctx.input("InfNorm")
+    lr = ctx.input("LearningRate").reshape(())
+    b1p = ctx.input("Beta1Pow").reshape(())
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    m_out = b1 * m + (1 - b1) * g
+    u_out = jnp.maximum(b2 * u, jnp.abs(g))
+    p_out = p - (lr / (1 - b1p)) * m_out / (u_out + eps)
+    ctx.set_output("ParamOut", p_out)
+    ctx.set_output("MomentOut", m_out)
+    ctx.set_output("InfNormOut", u_out)
+
+
+@register_op("adadelta", no_grad_slots=["Param", "Grad", "AvgSquaredGrad",
+                                        "AvgSquaredUpdate"])
+def _adadelta(ctx):
+    p = ctx.input("Param")
+    g = ctx.input("Grad")
+    sg = ctx.input("AvgSquaredGrad")
+    su = ctx.input("AvgSquaredUpdate")
+    rho = ctx.attr("rho", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    sg_out = rho * sg + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((su + eps) / (sg_out + eps)) * g
+    su_out = rho * su + (1 - rho) * jnp.square(update)
+    ctx.set_output("ParamOut", p + update)
+    ctx.set_output("AvgSquaredGradOut", sg_out)
+    ctx.set_output("AvgSquaredUpdateOut", su_out)
+
+
+@register_op("rmsprop", no_grad_slots=["Param", "Grad", "Moment",
+                                       "MeanSquare", "LearningRate"])
+def _rmsprop(ctx):
+    p = ctx.input("Param")
+    g = ctx.input("Grad")
+    mom = ctx.input("Moment")
+    ms = ctx.input("MeanSquare")
+    lr = ctx.input("LearningRate").reshape(())
+    rho = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    mu = ctx.attr("momentum", 0.0)
+    ms_out = rho * ms + (1 - rho) * jnp.square(g)
+    mom_out = mu * mom + lr * g / jnp.sqrt(ms_out + eps)
+    ctx.set_output("ParamOut", p - mom_out)
+    ctx.set_output("MomentOut", mom_out)
+    ctx.set_output("MeanSquareOut", ms_out)
+
+
+@register_op("decayed_adagrad", no_grad_slots=["Param", "Grad", "Moment",
+                                               "LearningRate"])
+def _decayed_adagrad(ctx):
+    p = ctx.input("Param")
+    g = ctx.input("Grad")
+    m = ctx.input("Moment")
+    lr = ctx.input("LearningRate").reshape(())
+    decay = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    m_out = decay * m + (1 - decay) * jnp.square(g)
+    ctx.set_output("ParamOut", p - lr * g / (jnp.sqrt(m_out) + eps))
+    ctx.set_output("MomentOut", m_out)
+
+
+@register_op("ftrl", no_grad_slots=["Param", "Grad", "SquaredAccumulator",
+                                    "LinearAccumulator", "LearningRate"])
+def _ftrl(ctx):
+    p = ctx.input("Param")
+    g = ctx.input("Grad")
+    sq = ctx.input("SquaredAccumulator")
+    lin = ctx.input("LinearAccumulator")
+    lr = ctx.input("LearningRate").reshape(())
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    power = ctx.attr("lr_power", -0.5)
+    new_sq = sq + jnp.square(g)
+    sigma = (jnp.power(new_sq, -power) - jnp.power(sq, -power)) / lr
+    lin_out = lin + g - sigma * p
+    quad = jnp.power(new_sq, -power) / lr + 2 * l2
+    pre = jnp.clip(lin_out, -l1, l1) - lin_out
+    p_out = jnp.where(jnp.abs(lin_out) > l1, pre / quad, jnp.zeros_like(p))
+    ctx.set_output("ParamOut", p_out)
+    ctx.set_output("SquaredAccumOut", new_sq)
+    ctx.set_output("LinearAccumOut", lin_out)
+
+
+@register_op("lars_momentum", no_grad_slots=["Param", "Grad", "Velocity",
+                                             "LearningRate"])
+def _lars_momentum(ctx):
+    p = ctx.input("Param")
+    g = ctx.input("Grad")
+    v = ctx.input("Velocity")
+    lr = ctx.input("LearningRate").reshape(())
+    mu = ctx.attr("mu", 0.9)
+    coeff = ctx.attr("lars_coeff", 0.001)
+    decay = ctx.attr("lars_weight_decay", 0.0005)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = lr * coeff * p_norm / (g_norm + decay * p_norm + 1e-12)
+    v_out = mu * v + local_lr * (g + decay * p)
+    ctx.set_output("ParamOut", p - v_out)
+    ctx.set_output("VelocityOut", v_out)

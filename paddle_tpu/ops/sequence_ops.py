@@ -1,0 +1,356 @@
+"""Variable-length sequence ops over ragged batches, and scan-based RNNs.
+
+The reference implements these over LoDTensors with CPU/CUDA kernels that
+reorder ragged batches (sequence2batch, reference:
+paddle/fluid/operators/math/sequence2batch.h, lstm_op.cc, gru_op.cc,
+sequence_pool_op.cc, sequence_softmax_op.cc, sequence_expand_op.cc,
+sequence_conv_op.cc, row_conv_op.cc). The TPU-native design: ragged data is
+(padded [n, maxlen, ...], lengths) — see core/lod.py — masked compute over
+dense tiles keeps the MXU busy, and recurrences are jax.lax.scan so XLA
+compiles one fused loop body instead of per-timestep kernel launches.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lod import RaggedPair
+from ..core.registry import register_op
+
+
+def _as_ragged(x) -> RaggedPair:
+    if isinstance(x, RaggedPair):
+        return x
+    # Dense [n, t, ...] with all lengths = t.
+    lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    return RaggedPair(x, lengths)
+
+
+@register_op("sequence_pool")
+def _sequence_pool(ctx):
+    x = _as_ragged(ctx.input("X"))
+    ptype = ctx.attr("pooltype", "AVERAGE").upper()
+    data, lengths = x.data, x.lengths
+    mask = x.mask()
+    for _ in range(data.ndim - 2):
+        mask = mask[..., None]
+    maskf = mask.astype(data.dtype)
+    if ptype == "SUM":
+        out = jnp.sum(data * maskf, axis=1)
+    elif ptype == "AVERAGE":
+        denom = jnp.maximum(lengths, 1).astype(data.dtype)
+        denom = denom.reshape((-1,) + (1,) * (data.ndim - 2))
+        out = jnp.sum(data * maskf, axis=1) / denom
+    elif ptype == "SQRT":
+        denom = jnp.sqrt(jnp.maximum(lengths, 1).astype(data.dtype))
+        denom = denom.reshape((-1,) + (1,) * (data.ndim - 2))
+        out = jnp.sum(data * maskf, axis=1) / denom
+    elif ptype == "MAX":
+        neg = jnp.finfo(data.dtype).min
+        out = jnp.max(jnp.where(mask, data, neg), axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(lengths - 1, 0)
+        out = jnp.take_along_axis(
+            data, idx.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1
+        ).squeeze(1)
+    elif ptype == "FIRST":
+        out = data[:, 0]
+    else:
+        raise ValueError(f"unknown pooltype {ptype}")
+    ctx.set_output("Out", out)
+
+
+@register_op("sequence_softmax")
+def _sequence_softmax(ctx):
+    x = _as_ragged(ctx.input("X"))
+    mask = x.mask()
+    logits = jnp.where(mask, x.data.squeeze(-1) if x.data.ndim == 3
+                       and x.data.shape[-1] == 1 else x.data, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=1)
+    probs = jnp.where(mask, probs, 0.0)
+    if x.data.ndim == 3 and x.data.shape[-1] == 1:
+        probs = probs[..., None]
+    ctx.set_output("Out", RaggedPair(probs, x.lengths))
+
+
+@register_op("sequence_expand", no_grad_slots=["Y"])
+def _sequence_expand(ctx):
+    """Repeat each row of X per the ragged structure of Y
+    (reference: sequence_expand_op.cc, level-0 broadcast form)."""
+    x = ctx.input("X")          # dense [n, ...]
+    y = _as_ragged(ctx.input("Y"))
+    xd = x.data if isinstance(x, RaggedPair) else x
+    maxlen = y.data.shape[1]
+    out = jnp.repeat(xd[:, None], maxlen, axis=1)
+    ctx.set_output("Out", RaggedPair(out, y.lengths))
+
+
+@register_op("sequence_concat")
+def _sequence_concat(ctx):
+    xs = [_as_ragged(v) for v in ctx.inputs("X")]
+    # Concatenate along the time axis, compacting each row's valid prefix.
+    total_max = sum(x.data.shape[1] for x in xs)
+    n = xs[0].data.shape[0]
+    feat = xs[0].data.shape[2:]
+    out = jnp.zeros((n, total_max) + feat, xs[0].data.dtype)
+    lengths = sum((x.lengths for x in xs[1:]), xs[0].lengths)
+    pos = jnp.zeros((n,), jnp.int32)
+    t_idx = jnp.arange(total_max, dtype=jnp.int32)
+    for x in xs:
+        src_t = jnp.arange(x.data.shape[1], dtype=jnp.int32)
+        # dest positions for this piece: pos[i] + t for t < len_i
+        dest = pos[:, None] + src_t[None, :]
+        valid = src_t[None, :] < x.lengths[:, None]
+        onehot = (dest[:, :, None] == t_idx[None, None, :]) & valid[:, :, None]
+        contrib = jnp.einsum("nst,ns...->nt...", onehot.astype(x.data.dtype),
+                             x.data)
+        out = out + contrib
+        pos = pos + x.lengths
+    ctx.set_output("Out", RaggedPair(out, lengths))
+
+
+@register_op("sequence_reshape")
+def _sequence_reshape(ctx):
+    x = _as_ragged(ctx.input("X"))
+    new_dim = ctx.attr("new_dim")
+    n, t = x.data.shape[:2]
+    d = x.data.shape[2] if x.data.ndim > 2 else 1
+    factor = (t * d) // new_dim if new_dim else t
+    out = x.data.reshape(n, (t * d) // new_dim, new_dim)
+    new_len = (x.lengths * d) // new_dim
+    ctx.set_output("Out", RaggedPair(out, new_len))
+
+
+@register_op("sequence_slice", no_grad_slots=["Offset", "Length"])
+def _sequence_slice(ctx):
+    x = _as_ragged(ctx.input("X"))
+    offset = ctx.input("Offset").reshape(-1).astype(jnp.int32)
+    length = ctx.input("Length").reshape(-1).astype(jnp.int32)
+    maxlen = x.data.shape[1]
+    t = jnp.arange(maxlen, dtype=jnp.int32)
+    src = offset[:, None] + t[None, :]
+    src = jnp.minimum(src, maxlen - 1)
+    out = jnp.take_along_axis(
+        x.data, src.reshape(src.shape + (1,) * (x.data.ndim - 2)), axis=1)
+    mask = (t[None, :] < length[:, None])
+    maskx = mask.reshape(mask.shape + (1,) * (x.data.ndim - 2))
+    ctx.set_output("Out", RaggedPair(out * maskx.astype(out.dtype), length))
+
+
+@register_op("sequence_erase", no_grad_slots=["X"])
+def _sequence_erase(ctx):
+    x = _as_ragged(ctx.input("X"))
+    tokens = jnp.asarray(ctx.attr("tokens", []), jnp.int32)
+    data = x.data
+    keep = jnp.ones(data.shape[:2], bool)
+    for tok in ctx.attr("tokens", []):
+        keep &= (data.squeeze(-1) if data.ndim == 3 else data) != tok
+    keep &= x.mask()
+    # compact kept tokens to the left (stable)
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    gathered = jnp.take_along_axis(
+        data, order.reshape(order.shape + (1,) * (data.ndim - 2)), axis=1)
+    new_len = keep.sum(axis=1).astype(jnp.int32)
+    t = jnp.arange(data.shape[1], dtype=jnp.int32)
+    mask = (t[None, :] < new_len[:, None])
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    ctx.set_output("Out", RaggedPair(gathered * mask.astype(data.dtype),
+                                     new_len))
+
+
+@register_op("sequence_conv")
+def _sequence_conv(ctx):
+    """Context-window projection over each sequence
+    (reference: sequence_conv_op.cc / ContextProjection function)."""
+    x = _as_ragged(ctx.input("X"))
+    w = ctx.input("Filter")  # [ctx_len * d, out_d]
+    ctx_len = ctx.attr("contextLength", 3)
+    ctx_start = ctx.attr("contextStart", -(ctx_len // 2))
+    data = x.data  # [n, t, d]
+    n, t, d = data.shape
+    cols = []
+    for i in range(ctx_len):
+        shift = ctx_start + i
+        rolled = jnp.roll(data, -shift, axis=1)
+        tt = jnp.arange(t)
+        valid = (tt + shift >= 0) & (tt + shift < t)
+        cols.append(jnp.where(valid[None, :, None], rolled, 0.0))
+    ctxmat = jnp.concatenate(cols, axis=-1)  # [n, t, ctx_len*d]
+    out = jnp.einsum("ntc,co->nto", ctxmat, w)
+    mask = x.mask()[..., None].astype(out.dtype)
+    ctx.set_output("Out", RaggedPair(out * mask, x.lengths))
+
+
+@register_op("row_conv")
+def _row_conv(ctx):
+    x = _as_ragged(ctx.input("X"))
+    w = ctx.input("Filter")  # [future_ctx, d]
+    data = x.data
+    k = w.shape[0]
+    outs = jnp.zeros_like(data)
+    t = data.shape[1]
+    for i in range(k):
+        rolled = jnp.roll(data, -i, axis=1)
+        tt = jnp.arange(t)
+        valid = (tt + i < t)
+        outs = outs + jnp.where(valid[None, :, None], rolled, 0.0) * w[i][None,
+                                                                         None]
+    mask = x.mask()[..., None].astype(data.dtype)
+    ctx.set_output("Out", RaggedPair(outs * mask, x.lengths))
+
+
+# -- recurrent nets ---------------------------------------------------------
+
+def _masked_scan_rnn(step, xs, init_states, lengths):
+    """Run `step` over time axis 1 of xs, freezing state past each row's
+    length. step(carry, x_t) -> (carry, out_t); carry is a tuple."""
+    maxlen = xs.shape[1]
+    tpos = jnp.arange(maxlen, dtype=jnp.int32)
+
+    def body(carry, inp):
+        t, x_t = inp
+        new_carry, out_t = step(carry, x_t)
+        alive = (t < lengths).reshape((-1,) + (1,) * (out_t.ndim - 1))
+        sel = lambda n, o: jnp.where(alive, n, o)
+        carry = tuple(sel(n, o) for n, o in zip(new_carry, carry))
+        return carry, out_t * alive.astype(out_t.dtype)
+
+    xs_t = jnp.moveaxis(xs, 1, 0)  # [t, n, ...]
+    carry, outs = jax.lax.scan(body, init_states, (tpos, xs_t))
+    return carry, jnp.moveaxis(outs, 0, 1)
+
+
+_ACT = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh, "relu": jax.nn.relu,
+        "identity": lambda x: x}
+
+
+@register_op("lstm")
+def _lstm(ctx):
+    """Dynamic LSTM over ragged input (reference: lstm_op.cc).
+
+    Input: ragged [n, t, 4h] (already projected by a mul op, as in the
+    reference), Weight [h, 4h] recurrent weights, Bias [1, 4h] (+ peephole
+    terms unsupported). Gate order i, c, f, o matches the reference
+    (operators/math/detail/lstm_kernel.h usage in lstm_op).
+    """
+    x = _as_ragged(ctx.input("Input"))
+    w = ctx.input("Weight")
+    b = ctx.input("Bias")
+    h_dim = w.shape[0]
+    n = x.data.shape[0]
+    gate_act = _ACT[ctx.attr("gate_activation", "sigmoid")]
+    cell_act = _ACT[ctx.attr("cell_activation", "tanh")]
+    cand_act = _ACT[ctx.attr("candidate_activation", "tanh")]
+    is_reverse = ctx.attr("is_reverse", False)
+
+    data = x.data
+    if is_reverse:
+        # reverse each sequence's valid prefix
+        t = data.shape[1]
+        idx = (x.lengths[:, None] - 1 - jnp.arange(t)[None, :]) % t
+        data = jnp.take_along_axis(data, idx[..., None], axis=1)
+
+    h0 = ctx.input("H0")
+    c0 = ctx.input("C0")
+    h0 = h0 if h0 is not None else jnp.zeros((n, h_dim), data.dtype)
+    c0 = c0 if c0 is not None else jnp.zeros((n, h_dim), data.dtype)
+
+    def step(carry, x_t):
+        h_prev, c_prev = carry
+        gates = x_t + h_prev @ w
+        if b is not None:
+            gates = gates + b.reshape(1, -1)[:, :4 * h_dim]
+        i, c_hat, f, o = jnp.split(gates, 4, axis=-1)
+        i = gate_act(i)
+        f = gate_act(f)
+        o = gate_act(o)
+        c = f * c_prev + i * cand_act(c_hat)
+        h = o * cell_act(c)
+        return (h, c), h
+
+    (h_last, c_last), hidden = _masked_scan_rnn(step, data, (h0, c0),
+                                                x.lengths)
+    if is_reverse:
+        t = hidden.shape[1]
+        idx = (x.lengths[:, None] - 1 - jnp.arange(t)[None, :]) % t
+        hidden = jnp.take_along_axis(hidden, idx[..., None], axis=1)
+    ctx.set_output("Hidden", RaggedPair(hidden, x.lengths))
+    ctx.set_output("Cell", RaggedPair(jnp.zeros_like(hidden), x.lengths))
+    ctx.set_output("LastH", h_last)
+    ctx.set_output("LastC", c_last)
+
+
+@register_op("gru")
+def _gru(ctx):
+    """Dynamic GRU over ragged input (reference: gru_op.cc).
+    Input ragged [n, t, 3h] pre-projected; Weight packs [h, 2h] update/reset
+    and [h, h] candidate, as in the reference layout."""
+    x = _as_ragged(ctx.input("Input"))
+    w = ctx.input("Weight")  # [h, 3h]
+    b = ctx.input("Bias")
+    h_dim = w.shape[0]
+    n = x.data.shape[0]
+    gate_act = _ACT[ctx.attr("gate_activation", "sigmoid")]
+    cand_act = _ACT[ctx.attr("activation", "tanh")]
+    w_ur = w[:, :2 * h_dim]
+    w_c = w[:, 2 * h_dim:]
+
+    h0 = ctx.input("H0")
+    h0 = h0 if h0 is not None else jnp.zeros((n, h_dim), x.data.dtype)
+
+    def step(carry, x_t):
+        (h_prev,) = carry
+        if b is not None:
+            x_t = x_t + b.reshape(1, -1)
+        xu, xr, xc = jnp.split(x_t, 3, axis=-1)
+        ur = h_prev @ w_ur
+        hu, hr = jnp.split(ur, 2, axis=-1)
+        u = gate_act(xu + hu)
+        r = gate_act(xr + hr)
+        c = cand_act(xc + (r * h_prev) @ w_c)
+        h = u * h_prev + (1 - u) * c
+        return (h,), h
+
+    (h_last,), hidden = _masked_scan_rnn(step, x.data, (h0,), x.lengths)
+    ctx.set_output("Hidden", RaggedPair(hidden, x.lengths))
+    ctx.set_output("LastH", h_last)
+
+
+@register_op("sequence_mask", no_grad_slots=["X"])
+def _sequence_mask(ctx):
+    lengths = ctx.input("X").reshape(-1)
+    maxlen = ctx.attr("maxlen", -1)
+    if maxlen <= 0:
+        raise ValueError("sequence_mask on TPU needs a static maxlen attr")
+    pos = jnp.arange(maxlen, dtype=lengths.dtype)
+    ctx.set_output("Y", (pos[None, :] < lengths[:, None]).astype(jnp.float32))
+
+
+@register_op("sequence_pad")
+def _sequence_pad(ctx):
+    x = _as_ragged(ctx.input("X"))
+    ctx.set_output("Out", x.data)
+    ctx.set_output("Length", x.lengths.astype(jnp.int64))
+
+
+@register_op("sequence_unpad", no_grad_slots=["Length"])
+def _sequence_unpad(ctx):
+    x = ctx.input("X")
+    lengths = ctx.input("Length").reshape(-1).astype(jnp.int32)
+    ctx.set_output("Out", RaggedPair(x, lengths))
+
+
+@register_op("sequence_last_step")
+def _sequence_last_step(ctx):
+    x = _as_ragged(ctx.input("X"))
+    idx = jnp.maximum(x.lengths - 1, 0)
+    out = jnp.take_along_axis(
+        x.data, idx.reshape((-1, 1) + (1,) * (x.data.ndim - 2)), axis=1
+    ).squeeze(1)
+    ctx.set_output("Out", out)
+
+
+@register_op("sequence_first_step")
+def _sequence_first_step(ctx):
+    x = _as_ragged(ctx.input("X"))
+    ctx.set_output("Out", x.data[:, 0])

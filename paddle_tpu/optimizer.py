@@ -1,0 +1,393 @@
+"""Optimizers: build the backward pass then append one update op per param.
+
+Reference parity: python/paddle/fluid/optimizer.py (Optimizer:34,
+_create_optimization_pass:207, minimize:224; SGD:250, Momentum:276,
+Adagrad:320, Adam:361, Adamax:466, DecayedAdagrad:550, Adadelta:594,
+RMSProp:676) plus Ftrl/LarsMomentum. The whole pass — grads + updates —
+lands in ONE jitted XLA program per training step.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from . import framework
+from .core.backward import append_backward
+from .framework import Program, Variable, default_startup_program, \
+    unique_name
+from .initializer import ConstantInitializer
+from .regularizer import append_regularization_ops
+from .clip import append_gradient_clip_ops
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self._lr = learning_rate
+        self._lr_var: Optional[Variable] = None
+        self.regularization = regularization
+        self._name = name
+        self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self.type = getattr(self, "type", "optimizer")
+        self.helper = None
+
+    # -- learning rate ------------------------------------------------------
+    def _create_lr_var(self, program: Program) -> Variable:
+        if self._lr_var is not None:
+            return self._lr_var
+        name = unique_name("learning_rate")
+        block = program.global_block()
+        lr = block.create_var(name=name, shape=[1], dtype="float32",
+                              persistable=True, stop_gradient=True)
+        startup = default_startup_program().global_block()
+        sv = startup.create_var(name=name, shape=[1], dtype="float32",
+                                persistable=True)
+        init_val = self._lr if isinstance(self._lr, (int, float)) \
+            else self._lr(0)
+        startup.append_op("fill_constant", outputs={"Out": sv},
+                          attrs={"shape": [1], "dtype": "float32",
+                                 "value": float(init_val)})
+        self._lr_var = lr
+        return lr
+
+    @property
+    def learning_rate_var(self):
+        return self._lr_var
+
+    def set_lr_in_scope(self, step: int, scope=None):
+        """Host-side schedule hook: refresh the LR value for `step`."""
+        if not callable(self._lr) or self._lr_var is None:
+            return
+        import jax.numpy as jnp
+        from .core.scope import global_scope
+        scope = scope or global_scope()
+        scope.set(self._lr_var.name,
+                  jnp.asarray([float(self._lr(step))], jnp.float32))
+
+    # -- accumulators -------------------------------------------------------
+    def _add_accumulator(self, name: str, param: Variable, fill_value=0.0,
+                         shape=None, dtype=None) -> Variable:
+        table = self._accumulators.setdefault(name, {})
+        if param.name in table:
+            return table[param.name]
+        var_name = unique_name(f"{param.name}_{name}")
+        shape = shape if shape is not None else list(param.shape)
+        dtype = dtype or param.dtype
+        block = param.block.program.global_block()
+        acc = block.create_var(name=var_name, shape=shape, dtype=dtype,
+                               persistable=True, stop_gradient=True)
+        startup = default_startup_program().global_block()
+        sv = startup.create_var(name=var_name, shape=shape, dtype=dtype,
+                                persistable=True)
+        startup.append_op("fill_constant", outputs={"Out": sv},
+                          attrs={"shape": shape, "dtype": dtype,
+                                 "value": float(fill_value)})
+        table[param.name] = acc
+        return acc
+
+    def _get_accumulator(self, name: str, param: Variable) -> Variable:
+        return self._accumulators[name][param.name]
+
+    # -- hooks for subclasses ----------------------------------------------
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block):
+        pass
+
+    # -- the pass -----------------------------------------------------------
+    def _create_optimization_pass(self, params_grads, loss):
+        program = loss.block.program
+        block = program.global_block()
+        self._create_lr_var(program)
+        self._create_accumulators(
+            block, [p for p, _ in params_grads])
+        ops = []
+        for param_and_grad in params_grads:
+            ops.append(self._append_optimize_op(block, param_and_grad))
+        self._finish_update(block)
+        return ops
+
+    def minimize(self, loss: Variable, startup_program=None,
+                 parameter_list=None, no_grad_set=None):
+        pg_names = append_backward(loss, parameter_list=parameter_list,
+                                   no_grad_set=no_grad_set)
+        program = loss.block.program
+        block = program.global_block()
+        params_grads: List[Tuple[Variable, Variable]] = []
+        for pname, gname in pg_names:
+            params_grads.append((block.var(pname), block.var(gname)))
+        params_grads = append_gradient_clip_ops(params_grads)
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        opt_ops = self._create_optimization_pass(params_grads, loss)
+        return opt_ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    type = "sgd"
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "sgd", inputs={"Param": p, "Grad": g,
+                           "LearningRate": self._lr_var},
+            outputs={"ParamOut": p})
+
+
+class MomentumOptimizer(Optimizer):
+    type = "momentum"
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "momentum",
+            inputs={"Param": p, "Grad": g, "Velocity": v,
+                    "LearningRate": self._lr_var},
+            outputs={"ParamOut": p, "VelocityOut": v},
+            attrs={"mu": self._momentum,
+                   "use_nesterov": self._use_nesterov})
+
+
+class AdagradOptimizer(Optimizer):
+    type = "adagrad"
+
+    def __init__(self, learning_rate, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "adagrad",
+            inputs={"Param": p, "Grad": g, "Moment": m,
+                    "LearningRate": self._lr_var},
+            outputs={"ParamOut": p, "MomentOut": m},
+            attrs={"epsilon": self._epsilon})
+
+
+class AdamOptimizer(Optimizer):
+    type = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow", p, fill_value=self._beta1,
+                                  shape=[1])
+            self._add_accumulator("beta2_pow", p, fill_value=self._beta2,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow", p)
+        b2p = self._get_accumulator("beta2_pow", p)
+        return block.append_op(
+            "adam",
+            inputs={"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+                    "LearningRate": self._lr_var, "Beta1Pow": b1p,
+                    "Beta2Pow": b2p},
+            outputs={"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
+                     "Beta1PowOut": b1p, "Beta2PowOut": b2p},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon})
+
+
+class AdamaxOptimizer(Optimizer):
+    type = "adamax"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow", p, fill_value=self._beta1,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "adamax",
+            inputs={"Param": p, "Grad": g,
+                    "Moment": self._get_accumulator("moment", p),
+                    "InfNorm": self._get_accumulator("inf_norm", p),
+                    "LearningRate": self._lr_var,
+                    "Beta1Pow": self._get_accumulator("beta1_pow", p)},
+            outputs={"ParamOut": p,
+                     "MomentOut": self._get_accumulator("moment", p),
+                     "InfNormOut": self._get_accumulator("inf_norm", p)},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon})
+
+    def _finish_update(self, block):
+        for pname, b1p in self._accumulators.get("beta1_pow", {}).items():
+            block.append_op("scale", inputs={"X": b1p},
+                            outputs={"Out": b1p},
+                            attrs={"scale": self._beta1})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    type = "decayed_adagrad"
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "decayed_adagrad",
+            inputs={"Param": p, "Grad": g, "Moment": m,
+                    "LearningRate": self._lr_var},
+            outputs={"ParamOut": p, "MomentOut": m},
+            attrs={"decay": self._decay, "epsilon": self._epsilon})
+
+
+class AdadeltaOptimizer(Optimizer):
+    type = "adadelta"
+
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        sg = self._get_accumulator("avg_squared_grad", p)
+        su = self._get_accumulator("avg_squared_update", p)
+        return block.append_op(
+            "adadelta",
+            inputs={"Param": p, "Grad": g, "AvgSquaredGrad": sg,
+                    "AvgSquaredUpdate": su},
+            outputs={"ParamOut": p, "AvgSquaredGradOut": sg,
+                     "AvgSquaredUpdateOut": su},
+            attrs={"epsilon": self._epsilon, "rho": self._rho})
+
+
+class RMSPropOptimizer(Optimizer):
+    type = "rmsprop"
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon, self._momentum = rho, epsilon, momentum
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("momentum", p)
+            self._add_accumulator("mean_square", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        mom = self._get_accumulator("momentum", p)
+        ms = self._get_accumulator("mean_square", p)
+        return block.append_op(
+            "rmsprop",
+            inputs={"Param": p, "Grad": g, "Moment": mom,
+                    "MeanSquare": ms, "LearningRate": self._lr_var},
+            outputs={"ParamOut": p, "MomentOut": mom,
+                     "MeanSquareOut": ms},
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum})
+
+
+class FtrlOptimizer(Optimizer):
+    type = "ftrl"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        sq = self._get_accumulator("squared", p)
+        lin = self._get_accumulator("linear", p)
+        return block.append_op(
+            "ftrl",
+            inputs={"Param": p, "Grad": g, "SquaredAccumulator": sq,
+                    "LinearAccumulator": lin,
+                    "LearningRate": self._lr_var},
+            outputs={"ParamOut": p, "SquaredAccumOut": sq,
+                     "LinearAccumOut": lin},
+            attrs={"l1": self._l1, "l2": self._l2,
+                   "lr_power": self._lr_power})
+
+
+class LarsMomentumOptimizer(Optimizer):
+    type = "lars_momentum"
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "lars_momentum",
+            inputs={"Param": p, "Grad": g, "Velocity": v,
+                    "LearningRate": self._lr_var},
+            outputs={"ParamOut": p, "VelocityOut": v},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay})
+
+
+# Short aliases matching fluid's public names.
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+LarsMomentum = LarsMomentumOptimizer

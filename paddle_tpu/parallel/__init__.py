@@ -1,0 +1,2 @@
+from .mesh import get_mesh, make_mesh, mesh_shape  # noqa: F401
+from .executor import ParallelExecutor  # noqa: F401

@@ -1,0 +1,88 @@
+"""Profiler (reference: platform/profiler.h RecordEvent tables + CUPTI
+device tracer + tools/timeline.py chrome-trace export).
+
+TPU-native design: host-side events wrap executor runs; device activity
+comes from jax.profiler (XLA/TPU trace), which natively emits
+chrome://tracing-compatible output — the xprof analog of the reference's
+CUPTI + timeline.py pipeline.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Dict, List, Optional
+
+_events: List[Dict] = []
+_enabled = False
+
+
+class RecordEvent:
+    """RAII event (reference: profiler.h:106)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.t0 = None
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if _enabled:
+            _events.append({"name": self.name, "ts": self.t0 * 1e6,
+                            "dur": (time.perf_counter() - self.t0) * 1e6,
+                            "ph": "X", "pid": 0, "tid": 0})
+        return False
+
+
+def start_profiler(state: str = "All"):
+    global _enabled
+    _enabled = True
+    _events.clear()
+
+
+def stop_profiler(sorted_key: Optional[str] = None,
+                  profile_path: Optional[str] = None):
+    global _enabled
+    _enabled = False
+    if profile_path:
+        export_chrome_trace(profile_path)
+    return summary()
+
+
+def summary():
+    agg: Dict[str, Dict] = {}
+    for e in _events:
+        a = agg.setdefault(e["name"], {"calls": 0, "total_us": 0.0})
+        a["calls"] += 1
+        a["total_us"] += e["dur"]
+    return agg
+
+
+def export_chrome_trace(path: str):
+    with open(path, "w") as f:
+        json.dump({"traceEvents": _events}, f)
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: Optional[str] = None,
+             profile_path: Optional[str] = None):
+    """Context manager parity with fluid.profiler.profiler (profiler.py:126)."""
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def device_profiler(logdir: str):
+    """TPU device trace via jax.profiler (xprof); view with tensorboard or
+    Perfetto. Replaces the reference's CUPTI DeviceTracer."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,27 @@
+"""Test configuration: force the CPU backend with 8 virtual devices so
+sharding/mesh tests run without TPU hardware (the driver separately
+dry-runs the multi-chip path via __graft_entry__.dryrun_multichip)."""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+# The environment may pin jax to a TPU-tunnel platform (slow to init);
+# tests always run on host CPU. config.update wins over the env var.
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Each test builds graphs into fresh default programs and scope."""
+    import paddle_tpu as pt
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    yield
