@@ -1,0 +1,60 @@
+"""Benchmark: ResNet-50 training throughput (images/sec) on one chip.
+
+Mirrors the reference's benchmark protocol (benchmark/fluid/run.sh:30-50 —
+skip warmup batches, then time N iterations). Baseline for vs_baseline is
+the reference's published ResNet-50 training throughput of 81.69 images/s
+(2x Xeon 6148, MKL-DNN; benchmark/IntelOptimizedPaddle.md:40-46 — the only
+ResNet-50 number the reference publishes; see BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+BASELINE_IMAGES_PER_SEC = 81.69
+
+BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+WARMUP = int(os.environ.get("BENCH_WARMUP", "3"))
+ITERS = int(os.environ.get("BENCH_ITERS", "10"))
+
+
+def main():
+    import paddle_tpu as pt
+    from paddle_tpu.models import resnet
+
+    main_p, startup, f = resnet.build_train(
+        class_dim=1000, depth=50, image_shape=(3, 224, 224), lr=0.1)
+
+    exe = pt.Executor()
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    img = rng.rand(BATCH, 3, 224, 224).astype(np.float32)
+    label = rng.randint(0, 1000, (BATCH, 1)).astype(np.int64)
+    feed = {"img": img, "label": label}
+
+    for _ in range(WARMUP):
+        exe.run(main_p, feed=feed, fetch_list=[f["loss"]])
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        (loss,) = exe.run(main_p, feed=feed, fetch_list=[f["loss"]])
+    # exe.run fetches to host, which synchronizes the device.
+    dt = time.perf_counter() - t0
+
+    images_per_sec = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from .ir import Program, BlockDesc, OpDesc
 from .lod import LoDTensor, RaggedPair
-from .registry import ExecutionContext, OpRegistry
+from .registry import run_op
 from .scope import Scope, global_scope
 
 STEP_VAR = "@step_counter@"
@@ -63,10 +63,7 @@ def trace_block(block: BlockDesc, env: Dict[str, Any],
                 extra: Dict[str, Any]) -> Dict[str, Any]:
     """Run every op's compute rule under trace, mutating env. Returns env."""
     for op in block.ops:
-        opdef = OpRegistry.get(op.type)
-        ctx = ExecutionContext(op, env, extra)
-        opdef.compute(ctx)
-        env.update(ctx.outputs)
+        env.update(run_op(op, env, extra))
     return env
 
 

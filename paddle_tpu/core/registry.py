@@ -28,8 +28,12 @@ class OpDef:
                  infer_shape: Optional[Callable] = None,
                  grad_maker: Optional[Callable] = None,
                  no_grad_slots: Optional[List[str]] = None,
-                 stateful: bool = False):
+                 stateful: bool = False,
+                 ragged_aware: bool = False):
         self.type = type
+        # ragged_aware ops receive RaggedPair values as-is; other ops get
+        # dense .data views and their outputs are re-wrapped (see run_op).
+        self.ragged_aware = ragged_aware
         # compute(ctx) -> None; reads ctx.input/attr, writes ctx.set_output.
         self.compute = compute
         # infer_shape(block, op) -> None; fills output VarDesc shapes/dtypes at
@@ -72,15 +76,64 @@ class OpRegistry:
 
 
 def register_op(type: str, infer_shape=None, grad_maker=None,
-                no_grad_slots=None, stateful=False):
+                no_grad_slots=None, stateful=False, ragged_aware=False):
     """Decorator: register `fn(ctx)` as the compute rule for op `type`."""
     def deco(fn):
         OpRegistry.register(OpDef(type, fn, infer_shape=infer_shape,
                                   grad_maker=grad_maker,
                                   no_grad_slots=no_grad_slots,
-                                  stateful=stateful))
+                                  stateful=stateful,
+                                  ragged_aware=ragged_aware))
         return fn
     return deco
+
+
+def run_op(op, env: Dict[str, Any], extra: Optional[Dict] = None
+           ) -> Dict[str, Any]:
+    """Run one op's compute rule against env, handling ragged transparency.
+
+    Non-ragged-aware ops see dense padded data; any output whose leading
+    (batch, time) dims match the first ragged input is re-wrapped as a
+    RaggedPair carrying that input's lengths. This is how the reference's
+    LoD propagation rule ("output lod = input lod", lod_tensor.md) maps to
+    the padded TPU representation.
+    """
+    from .lod import RaggedPair  # local import: lod has no registry dep
+
+    opdef = OpRegistry.get(op.type)
+    if opdef.ragged_aware:
+        ctx = ExecutionContext(op, env, extra)
+        opdef.compute(ctx)
+        return ctx.outputs
+
+    ragged_src: Optional[RaggedPair] = None
+    local = env
+    needs_copy = False
+    for name in op.input_names():
+        v = env.get(name)
+        if isinstance(v, RaggedPair):
+            needs_copy = True
+            if ragged_src is None:
+                ragged_src = v
+    if needs_copy:
+        local = dict(env)
+        for name in op.input_names():
+            v = local.get(name)
+            if isinstance(v, RaggedPair):
+                local[name] = v.data
+    ctx = ExecutionContext(op, local, extra)
+    opdef.compute(ctx)
+    if ragged_src is None:
+        return ctx.outputs
+    nt = ragged_src.data.shape[:2]
+    outputs = {}
+    for k, v in ctx.outputs.items():
+        if hasattr(v, "ndim") and v.ndim >= 2 and tuple(v.shape[:2]) == nt \
+                and not isinstance(v, RaggedPair):
+            outputs[k] = RaggedPair(v, ragged_src.lengths)
+        else:
+            outputs[k] = v
+    return outputs
 
 
 def register_grad(type: str):

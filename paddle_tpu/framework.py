@@ -194,10 +194,8 @@ def _infer_shapes_impl(block_desc: ir.BlockDesc, op: ir.OpDesc) -> None:
     import jax
     import jax.numpy as jnp
     from .core.lod import RaggedPair
-    from .core.registry import ExecutionContext
     from .ops.core_ops import jnp_dtype
 
-    opdef = OpRegistry.get(op.type)
     env = {}
     for name in op.input_names():
         v = block_desc.find_var_recursive(name)
@@ -216,14 +214,14 @@ def _infer_shapes_impl(block_desc: ir.BlockDesc, op: ir.OpDesc) -> None:
         else:
             env[name] = jax.ShapeDtypeStruct(tuple(shape), dt)
 
+    from .core.registry import run_op
+
     def run(inputs):
         local = dict(inputs)
-        ctx = ExecutionContext(op, local, extra={
+        return run_op(op, local, extra={
             "prng": lambda seed: jax.random.PRNGKey(0),
             "step": jnp.zeros((), jnp.int32),
         })
-        opdef.compute(ctx)
-        return ctx.outputs
 
     outs = jax.eval_shape(run, env)
     for name, aval in outs.items():

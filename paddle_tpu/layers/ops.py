@@ -13,7 +13,12 @@ _UNARY_OPS = [
     "softplus", "softsign", "log", "sign",
 ]
 
-__all__ = list(_UNARY_OPS) + ["uniform_random", "gaussian_random"]
+_CMP_OPS = ["equal", "not_equal", "less_than", "less_equal",
+            "greater_than", "greater_equal", "logical_and", "logical_or",
+            "logical_xor"]
+
+__all__ = list(_UNARY_OPS) + list(_CMP_OPS) + [
+    "uniform_random", "gaussian_random", "logical_not", "isfinite"]
 
 
 def _make_unary(op_type):
@@ -30,6 +35,40 @@ def _make_unary(op_type):
 
 for _op in _UNARY_OPS:
     globals()[_op] = _make_unary(_op)
+
+
+def _make_cmp(op_type):
+    def fn(x, y, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_tmp_variable("bool", lod_level=x.lod_level)
+        out.stop_gradient = True
+        helper.append_op(type=op_type, inputs={"X": x, "Y": y},
+                         outputs={"Out": out}, attrs={"axis": -1})
+        return out
+    fn.__name__ = op_type
+    return fn
+
+
+for _op in _CMP_OPS:
+    globals()[_op] = _make_cmp(_op)
+
+
+def logical_not(x, name=None):
+    helper = LayerHelper("logical_not", name=name)
+    out = helper.create_tmp_variable("bool", lod_level=x.lod_level)
+    out.stop_gradient = True
+    helper.append_op(type="logical_not", inputs={"X": x},
+                     outputs={"Out": out})
+    return out
+
+
+def isfinite(x):
+    helper = LayerHelper("isfinite")
+    out = helper.create_tmp_variable("bool")
+    out.stop_gradient = True
+    helper.append_op(type="isfinite", inputs={"X": x},
+                     outputs={"Out": out})
+    return out
 
 
 def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):

@@ -1,0 +1,10 @@
+"""Model zoo matching the reference's benchmark/book models
+(BASELINE.json configs): MNIST conv, ResNet-50, VGG-16, stacked-LSTM
+language model, Transformer NMT, DeepFM CTR.
+"""
+from . import mnist  # noqa: F401
+from . import resnet  # noqa: F401
+from . import vgg  # noqa: F401
+from . import lstm_lm  # noqa: F401
+from . import transformer  # noqa: F401
+from . import deepfm  # noqa: F401

@@ -13,7 +13,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from functools import partial
+
 from ..core.registry import register_op
+
+register_op_CF = partial(register_op, ragged_aware=True)
 
 
 def _trace_sub(ctx, block_idx, env):
@@ -22,7 +26,7 @@ def _trace_sub(ctx, block_idx, env):
     return trace_block(prog.blocks[block_idx], env, ctx.extra)
 
 
-@register_op("static_rnn")
+@register_op_CF("static_rnn")
 def _static_rnn(ctx):
     """Scan over leading time axis of each step input."""
     xs = ctx.inputs("X")                 # each [T, ...]
@@ -48,7 +52,7 @@ def _static_rnn(ctx):
     ctx.set_outputs("Out", list(stacked))
 
 
-@register_op("while")
+@register_op_CF("while")
 def _while(ctx):
     cond_name = ctx.attr("cond_name")
     carried = ctx.attr("carried_names")
@@ -73,7 +77,7 @@ def _while(ctx):
     ctx.set_outputs("Out", list(final[1:]))
 
 
-@register_op("cond")
+@register_op_CF("cond")
 def _cond(ctx):
     pred = ctx.input("Pred")
     outer = dict(ctx.env)
@@ -96,7 +100,7 @@ def _cond(ctx):
 
 # -- tensor arrays (dense fixed-capacity form) ------------------------------
 
-@register_op("array_write", no_grad_slots=["I"])
+@register_op_CF("array_write", no_grad_slots=["I"])
 def _array_write(ctx):
     x = ctx.input("X")
     i = ctx.input("I").reshape(()).astype(jnp.int32)
@@ -108,7 +112,7 @@ def _array_write(ctx):
     ctx.set_output("Out", out)
 
 
-@register_op("array_read", no_grad_slots=["I"])
+@register_op_CF("array_read", no_grad_slots=["I"])
 def _array_read(ctx):
     arr = ctx.input("Array")
     i = ctx.input("I").reshape(()).astype(jnp.int32)
@@ -116,7 +120,7 @@ def _array_read(ctx):
                                                        keepdims=False))
 
 
-@register_op("array_length", no_grad_slots=["Array"])
+@register_op_CF("array_length", no_grad_slots=["Array"])
 def _array_length(ctx):
     arr = ctx.input("Array")
     ctx.set_output("Out", jnp.asarray(arr.shape[0], jnp.int64))

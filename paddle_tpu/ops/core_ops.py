@@ -191,7 +191,7 @@ def _shape(ctx):
 
 # -- the generic grad op ----------------------------------------------------
 
-@register_op("__vjp__")
+@register_op("__vjp__", ragged_aware=True)
 def _vjp(ctx):
     """Gradient of an arbitrary forward op via jax.vjp on its compute rule.
 
@@ -210,15 +210,16 @@ def _vjp(ctx):
 
     # Only grad-receiving outputs go through vjp (others contribute nothing),
     # and ragged values pass as their dense data (lengths are non-diff ints).
+    from ..core.registry import run_op
+
     def f(vals):
         env = {}
         for n, v in zip(fwd_in_names, vals):
             env[n] = v
-        sub = ExecutionContext(fwd, env, ctx.extra)
-        fwd_def.compute(sub)
+        outs = run_op(fwd, env, ctx.extra)
         res = []
         for n in grad_out_names:
-            v = sub.outputs[n]
+            v = outs[n]
             res.append(v.data if isinstance(v, RaggedPair) else v)
         return tuple(res)
 

@@ -82,7 +82,7 @@ def _precision_recall(ctx):
     ctx.set_output("AccumMetrics", metrics)
 
 
-@register_op("edit_distance", no_grad_slots=["Hyps", "Refs"])
+@register_op("edit_distance", no_grad_slots=["Hyps", "Refs"], ragged_aware=True)
 def _edit_distance(ctx):
     """Levenshtein distance between ragged hypothesis/reference int
     sequences (reference: edit_distance_op.cu) via a dense DP in-graph."""

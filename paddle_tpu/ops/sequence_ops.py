@@ -15,7 +15,12 @@ import jax
 import jax.numpy as jnp
 
 from ..core.lod import RaggedPair
+from functools import partial
+
 from ..core.registry import register_op
+
+# Every op in this module consumes/produces RaggedPair values natively.
+register_op_SEQ = partial(register_op, ragged_aware=True)
 
 
 def _as_ragged(x) -> RaggedPair:
@@ -26,7 +31,7 @@ def _as_ragged(x) -> RaggedPair:
     return RaggedPair(x, lengths)
 
 
-@register_op("sequence_pool")
+@register_op_SEQ("sequence_pool")
 def _sequence_pool(ctx):
     x = _as_ragged(ctx.input("X"))
     ptype = ctx.attr("pooltype", "AVERAGE").upper()
@@ -60,7 +65,7 @@ def _sequence_pool(ctx):
     ctx.set_output("Out", out)
 
 
-@register_op("sequence_softmax")
+@register_op_SEQ("sequence_softmax")
 def _sequence_softmax(ctx):
     x = _as_ragged(ctx.input("X"))
     mask = x.mask()
@@ -73,7 +78,7 @@ def _sequence_softmax(ctx):
     ctx.set_output("Out", RaggedPair(probs, x.lengths))
 
 
-@register_op("sequence_expand", no_grad_slots=["Y"])
+@register_op_SEQ("sequence_expand", no_grad_slots=["Y"])
 def _sequence_expand(ctx):
     """Repeat each row of X per the ragged structure of Y
     (reference: sequence_expand_op.cc, level-0 broadcast form)."""
@@ -85,7 +90,7 @@ def _sequence_expand(ctx):
     ctx.set_output("Out", RaggedPair(out, y.lengths))
 
 
-@register_op("sequence_concat")
+@register_op_SEQ("sequence_concat")
 def _sequence_concat(ctx):
     xs = [_as_ragged(v) for v in ctx.inputs("X")]
     # Concatenate along the time axis, compacting each row's valid prefix.
@@ -109,7 +114,7 @@ def _sequence_concat(ctx):
     ctx.set_output("Out", RaggedPair(out, lengths))
 
 
-@register_op("sequence_reshape")
+@register_op_SEQ("sequence_reshape")
 def _sequence_reshape(ctx):
     x = _as_ragged(ctx.input("X"))
     new_dim = ctx.attr("new_dim")
@@ -121,7 +126,7 @@ def _sequence_reshape(ctx):
     ctx.set_output("Out", RaggedPair(out, new_len))
 
 
-@register_op("sequence_slice", no_grad_slots=["Offset", "Length"])
+@register_op_SEQ("sequence_slice", no_grad_slots=["Offset", "Length"])
 def _sequence_slice(ctx):
     x = _as_ragged(ctx.input("X"))
     offset = ctx.input("Offset").reshape(-1).astype(jnp.int32)
@@ -137,7 +142,7 @@ def _sequence_slice(ctx):
     ctx.set_output("Out", RaggedPair(out * maskx.astype(out.dtype), length))
 
 
-@register_op("sequence_erase", no_grad_slots=["X"])
+@register_op_SEQ("sequence_erase", no_grad_slots=["X"])
 def _sequence_erase(ctx):
     x = _as_ragged(ctx.input("X"))
     tokens = jnp.asarray(ctx.attr("tokens", []), jnp.int32)
@@ -158,7 +163,7 @@ def _sequence_erase(ctx):
                                      new_len))
 
 
-@register_op("sequence_conv")
+@register_op_SEQ("sequence_conv")
 def _sequence_conv(ctx):
     """Context-window projection over each sequence
     (reference: sequence_conv_op.cc / ContextProjection function)."""
@@ -181,7 +186,7 @@ def _sequence_conv(ctx):
     ctx.set_output("Out", RaggedPair(out * mask, x.lengths))
 
 
-@register_op("row_conv")
+@register_op_SEQ("row_conv")
 def _row_conv(ctx):
     x = _as_ragged(ctx.input("X"))
     w = ctx.input("Filter")  # [future_ctx, d]
@@ -224,7 +229,7 @@ _ACT = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh, "relu": jax.nn.relu,
         "identity": lambda x: x}
 
 
-@register_op("lstm")
+@register_op_SEQ("lstm")
 def _lstm(ctx):
     """Dynamic LSTM over ragged input (reference: lstm_op.cc).
 
@@ -280,7 +285,7 @@ def _lstm(ctx):
     ctx.set_output("LastC", c_last)
 
 
-@register_op("gru")
+@register_op_SEQ("gru")
 def _gru(ctx):
     """Dynamic GRU over ragged input (reference: gru_op.cc).
     Input ragged [n, t, 3h] pre-projected; Weight packs [h, 2h] update/reset
@@ -316,7 +321,7 @@ def _gru(ctx):
     ctx.set_output("LastH", h_last)
 
 
-@register_op("sequence_mask", no_grad_slots=["X"])
+@register_op_SEQ("sequence_mask", no_grad_slots=["X"])
 def _sequence_mask(ctx):
     lengths = ctx.input("X").reshape(-1)
     maxlen = ctx.attr("maxlen", -1)
@@ -326,21 +331,21 @@ def _sequence_mask(ctx):
     ctx.set_output("Y", (pos[None, :] < lengths[:, None]).astype(jnp.float32))
 
 
-@register_op("sequence_pad")
+@register_op_SEQ("sequence_pad")
 def _sequence_pad(ctx):
     x = _as_ragged(ctx.input("X"))
     ctx.set_output("Out", x.data)
     ctx.set_output("Length", x.lengths.astype(jnp.int64))
 
 
-@register_op("sequence_unpad", no_grad_slots=["Length"])
+@register_op_SEQ("sequence_unpad", no_grad_slots=["Length"])
 def _sequence_unpad(ctx):
     x = ctx.input("X")
     lengths = ctx.input("Length").reshape(-1).astype(jnp.int32)
     ctx.set_output("Out", RaggedPair(x, lengths))
 
 
-@register_op("sequence_last_step")
+@register_op_SEQ("sequence_last_step")
 def _sequence_last_step(ctx):
     x = _as_ragged(ctx.input("X"))
     idx = jnp.maximum(x.lengths - 1, 0)
@@ -350,7 +355,7 @@ def _sequence_last_step(ctx):
     ctx.set_output("Out", out)
 
 
-@register_op("sequence_first_step")
+@register_op_SEQ("sequence_first_step")
 def _sequence_first_step(ctx):
     x = _as_ragged(ctx.input("X"))
     ctx.set_output("Out", x.data[:, 0])

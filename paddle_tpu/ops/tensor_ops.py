@@ -221,7 +221,7 @@ def _masked_select(ctx):
     ctx.set_output("Count", jnp.sum(flat_m).astype(jnp.int64))
 
 
-@register_op("lod_reset", no_grad_slots=["Y"])
+@register_op("lod_reset", no_grad_slots=["Y"], ragged_aware=True)
 def _lod_reset(ctx):
     """Re-segment a ragged tensor with new sequence lengths
     (reference: lod_reset_op.cc). Dense in, dense out (lengths attached)."""

@@ -1,0 +1,111 @@
+"""Model-zoo construction + one training step on tiny shapes (book-test
+style: loss must be finite and decrease over a few steps for the small
+models)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.lod import LoDTensor
+
+
+def _run_steps(main, startup, feed_fn, loss_var, steps=3):
+    exe = pt.Executor()
+    exe.run(startup)
+    losses = []
+    for _ in range(steps):
+        (lv,) = exe.run(main, feed=feed_fn(), fetch_list=[loss_var])
+        arr = lv.data if hasattr(lv, "data") else lv
+        losses.append(float(np.asarray(arr).reshape(-1)[0]))
+    assert all(np.isfinite(l) for l in losses), losses
+    return losses
+
+
+def test_mnist_conv_trains():
+    from paddle_tpu.models import mnist
+    main, startup, f = mnist.build_train()
+    rng = np.random.RandomState(0)
+
+    batch = {"img": rng.rand(8, 1, 28, 28).astype(np.float32),
+             "label": rng.randint(0, 10, (8, 1)).astype(np.int64)}
+
+    losses = _run_steps(main, startup, lambda: batch, f["loss"], steps=5)
+    assert losses[-1] < losses[0]
+
+
+def test_resnet_cifar_builds_and_steps():
+    from paddle_tpu.models import resnet
+    main, startup, f = resnet.build_train(
+        class_dim=10, depth=18, image_shape=(3, 32, 32), lr=0.01)
+    rng = np.random.RandomState(0)
+
+    def feed():
+        return {"img": rng.rand(4, 3, 32, 32).astype(np.float32),
+                "label": rng.randint(0, 10, (4, 1)).astype(np.int64)}
+
+    _run_steps(main, startup, feed, f["loss"], steps=2)
+
+
+def test_vgg_builds_and_steps():
+    from paddle_tpu.models import vgg
+    main, startup, f = vgg.build_train(class_dim=10,
+                                       image_shape=(3, 32, 32))
+    rng = np.random.RandomState(0)
+
+    def feed():
+        return {"img": rng.rand(4, 3, 32, 32).astype(np.float32),
+                "label": rng.randint(0, 10, (4, 1)).astype(np.int64)}
+
+    _run_steps(main, startup, feed, f["loss"], steps=2)
+
+
+def test_lstm_lm_ragged_trains():
+    from paddle_tpu.models import lstm_lm
+    main, startup, f = lstm_lm.build_train(vocab_size=50, emb_dim=16,
+                                           hid_dim=16, num_layers=2,
+                                           lr=0.5)
+    rng = np.random.RandomState(0)
+
+    lens = [5, 3, 7, 2]
+    seqs = [rng.randint(1, 50, (l, 1)).astype(np.int64) for l in lens]
+    tgts = [np.roll(s, -1) for s in seqs]
+    batch = {"words": LoDTensor.from_sequences(seqs),
+             "targets": LoDTensor.from_sequences(tgts)}
+
+    losses = _run_steps(main, startup, lambda: batch, f["loss"], steps=4)
+    assert losses[-1] < losses[0]
+
+
+def test_transformer_builds_and_steps():
+    from paddle_tpu.models import transformer
+    main, startup, f = transformer.build_train(
+        src_vocab=64, trg_vocab=64, max_len=8, n_layer=1, n_head=2,
+        d_model=16, d_inner=32)
+    rng = np.random.RandomState(0)
+
+    def feed():
+        return {
+            "src_ids": rng.randint(1, 64, (2, 8, 1)).astype(np.int64),
+            "trg_ids": rng.randint(1, 64, (2, 8, 1)).astype(np.int64),
+            "trg_labels": rng.randint(1, 64, (2, 8, 1)).astype(np.int64),
+            "pos_ids": np.arange(8).astype(np.int64),
+        }
+
+    losses = _run_steps(main, startup, feed, f["loss"], steps=3)
+    assert losses[-1] < losses[0]
+
+
+def test_deepfm_builds_and_steps():
+    from paddle_tpu.models import deepfm
+    main, startup, f = deepfm.build_train(num_features=1000, num_fields=5,
+                                          embed_dim=4)
+    rng = np.random.RandomState(0)
+
+    def feed():
+        return {
+            "feat_ids": rng.randint(0, 1000, (8, 5, 1)).astype(np.int64),
+            "feat_vals": rng.rand(8, 5).astype(np.float32),
+            "label": rng.randint(0, 2, (8, 1)).astype(np.float32),
+        }
+
+    losses = _run_steps(main, startup, feed, f["loss"], steps=4)
+    assert losses[-1] < losses[0]
