@@ -1,0 +1,189 @@
+// loader.cc — multi-threaded prefetching record loader.
+//
+// Native data-loader for the TPU framework: N reader threads scan recordio
+// shards and push records into a bounded queue; the consumer side applies an
+// optional shuffle buffer. Capability parity with the reference's reader-op
+// chain — open_files (multi-threaded file reading, reference:
+// paddle/fluid/operators/reader/open_files_op.cc) -> shuffle
+// (create_shuffle_reader_op.cc) -> double-buffer prefetch
+// (create_double_buffer_reader_op.cc) -> multi-pass
+// (create_multi_pass_reader_op.cc) — collapsed into one native pipeline;
+// batching/decode happens in Python on top (numpy), device transfer in JAX.
+//
+// C ABI only (consumed from Python via ctypes).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+// From recordio.cc (same shared object).
+void* rio_scanner_open(const char* path);
+const char* rio_scanner_next(void* sp, uint64_t* len);
+void rio_scanner_close(void* sp);
+const char* rio_last_error();
+}
+
+namespace {
+
+struct Loader {
+  std::vector<std::string> paths;
+  int epochs = 1;  // <=0 means loop forever
+  size_t queue_capacity = 1024;
+
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::deque<std::string> queue;
+  bool done = false;       // all producer work finished
+  bool closing = false;    // consumer requested shutdown
+  std::atomic<int64_t> work_index{0};  // next (epoch*nfiles + file) item
+  int64_t total_work = 0;              // epochs * nfiles, or -1 for infinite
+  std::atomic<int> live_producers{0};
+  std::string error;
+
+  std::vector<std::thread> threads;
+
+  // Consumer-side shuffle buffer (single consumer).
+  size_t shuffle_capacity = 0;
+  std::vector<std::string> shuffle_buf;
+  std::mt19937_64 rng;
+
+  std::string current;  // last record handed to the caller
+
+  void producer() {
+    for (;;) {
+      int64_t idx = work_index.fetch_add(1);
+      if (total_work >= 0 && idx >= total_work) break;
+      const std::string& path = paths[size_t(idx) % paths.size()];
+      void* sc = rio_scanner_open(path.c_str());
+      if (!sc) {
+        std::lock_guard<std::mutex> l(mu);
+        if (error.empty()) error = rio_last_error();
+        break;
+      }
+      uint64_t len = 0;
+      const char* rec;
+      while ((rec = rio_scanner_next(sc, &len)) != nullptr) {
+        std::unique_lock<std::mutex> l(mu);
+        cv_push.wait(l, [&] { return queue.size() < queue_capacity || closing; });
+        if (closing) {
+          l.unlock();
+          rio_scanner_close(sc);
+          goto out;
+        }
+        queue.emplace_back(rec, len);
+        cv_pop.notify_one();
+      }
+      {
+        // nullptr may mean scan error rather than EOF.
+        const char* err = rio_last_error();
+        if (err && err[0]) {
+          std::lock_guard<std::mutex> l(mu);
+          if (error.empty()) error = err;
+          rio_scanner_close(sc);
+          break;
+        }
+      }
+      rio_scanner_close(sc);
+    }
+  out:
+    if (live_producers.fetch_sub(1) == 1) {
+      std::lock_guard<std::mutex> l(mu);
+      done = true;
+      cv_pop.notify_all();
+    }
+  }
+
+  // Pop one record from the queue; empty string + false means end of data.
+  bool pop_queue(std::string* out) {
+    std::unique_lock<std::mutex> l(mu);
+    cv_pop.wait(l, [&] { return !queue.empty() || done; });
+    if (queue.empty()) return false;
+    *out = std::move(queue.front());
+    queue.pop_front();
+    cv_push.notify_one();
+    return true;
+  }
+
+  const char* next(uint64_t* len) {
+    if (shuffle_capacity == 0) {
+      if (!pop_queue(&current)) {
+        *len = 0;
+        return nullptr;
+      }
+      *len = current.size();
+      return current.data();
+    }
+    // Keep the reservoir full, then emit a uniformly random element.
+    std::string rec;
+    while (shuffle_buf.size() < shuffle_capacity && pop_queue(&rec)) {
+      shuffle_buf.emplace_back(std::move(rec));
+    }
+    if (shuffle_buf.empty()) {
+      *len = 0;
+      return nullptr;
+    }
+    size_t i = rng() % shuffle_buf.size();
+    current = std::move(shuffle_buf[i]);
+    shuffle_buf[i] = std::move(shuffle_buf.back());
+    shuffle_buf.pop_back();
+    *len = current.size();
+    return current.data();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dl_open(const char** paths, int n_paths, int n_threads,
+              int shuffle_capacity, uint64_t seed, int epochs,
+              int queue_capacity) {
+  if (n_paths <= 0) return nullptr;
+  Loader* d = new Loader();
+  for (int i = 0; i < n_paths; i++) d->paths.emplace_back(paths[i]);
+  d->epochs = epochs;
+  d->total_work = epochs <= 0 ? -1 : int64_t(epochs) * n_paths;
+  if (queue_capacity > 0) d->queue_capacity = size_t(queue_capacity);
+  d->shuffle_capacity = shuffle_capacity > 0 ? size_t(shuffle_capacity) : 0;
+  d->rng.seed(seed);
+  int threads = n_threads > 0 ? n_threads : 1;
+  if (d->total_work >= 0 && threads > d->total_work) threads = int(d->total_work);
+  d->live_producers = threads;
+  for (int i = 0; i < threads; i++) {
+    d->threads.emplace_back([d] { d->producer(); });
+  }
+  return d;
+}
+
+const char* dl_next(void* dp, uint64_t* len) {
+  return static_cast<Loader*>(dp)->next(len);
+}
+
+// Non-empty string if any producer hit an error.
+const char* dl_error(void* dp) {
+  Loader* d = static_cast<Loader*>(dp);
+  std::lock_guard<std::mutex> l(d->mu);
+  return d->error.c_str();
+}
+
+void dl_close(void* dp) {
+  Loader* d = static_cast<Loader*>(dp);
+  {
+    std::lock_guard<std::mutex> l(d->mu);
+    d->closing = true;
+    d->cv_push.notify_all();
+    d->cv_pop.notify_all();
+  }
+  for (auto& t : d->threads) t.join();
+  delete d;
+}
+
+}  // extern "C"

@@ -1,0 +1,243 @@
+// recordio.cc — chunked record file format (writer + scanner).
+//
+// TPU-native framework's native data-file format. Capability parity with the
+// reference's RecordIO (reference: paddle/fluid/recordio/{header,chunk,
+// scanner,writer}.h — chunked, compressed, checksummed record files consumed
+// by reader ops), redesigned: little-endian fixed header, zlib compression
+// (the image has no snappy), CRC32 over the on-disk payload, and a
+// streaming scanner that validates per chunk.
+//
+// File layout:
+//   File  := Chunk*
+//   Chunk := Header Payload
+//   Header (24 bytes LE):
+//     u32 magic      = 0x7C9D2E4B
+//     u32 num_records
+//     u32 flags      (bit 0: payload is zlib-compressed)
+//     u32 payload_bytes   on-disk payload size
+//     u32 raw_bytes       uncompressed payload size
+//     u32 crc32           of the on-disk payload bytes
+//   Payload (after decompression) := repeated { u32 len; u8 data[len] }
+//
+// C ABI only (consumed from Python via ctypes).
+
+#include <zlib.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x7C9D2E4B;
+constexpr uint32_t kFlagCompressed = 1u;
+constexpr size_t kHeaderBytes = 24;
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+void put_u32(std::string* out, uint32_t v) {
+  char b[4] = {char(v & 0xff), char((v >> 8) & 0xff), char((v >> 16) & 0xff),
+               char((v >> 24) & 0xff)};
+  out->append(b, 4);
+}
+
+uint32_t get_u32(const uint8_t* p) {
+  return uint32_t(p[0]) | (uint32_t(p[1]) << 8) | (uint32_t(p[2]) << 16) |
+         (uint32_t(p[3]) << 24);
+}
+
+struct Writer {
+  FILE* f = nullptr;
+  bool compress = true;
+  size_t max_chunk_bytes = 1 << 20;  // flush a chunk at ~1MB of raw payload
+  std::string payload;               // raw (uncompressed) payload in progress
+  uint32_t num_records = 0;
+  uint64_t total_records = 0;
+
+  bool flush_chunk() {
+    if (num_records == 0) return true;
+    std::string disk;
+    uint32_t flags = 0;
+    if (compress) {
+      uLongf bound = compressBound(payload.size());
+      disk.resize(bound);
+      uLongf dst_len = bound;
+      if (compress2(reinterpret_cast<Bytef*>(&disk[0]), &dst_len,
+                    reinterpret_cast<const Bytef*>(payload.data()),
+                    payload.size(), Z_DEFAULT_COMPRESSION) != Z_OK) {
+        set_error("zlib compress failed");
+        return false;
+      }
+      disk.resize(dst_len);
+      flags |= kFlagCompressed;
+    } else {
+      disk = payload;
+    }
+    uint32_t crc =
+        crc32(0, reinterpret_cast<const Bytef*>(disk.data()), disk.size());
+    std::string header;
+    header.reserve(kHeaderBytes);
+    put_u32(&header, kMagic);
+    put_u32(&header, num_records);
+    put_u32(&header, flags);
+    put_u32(&header, uint32_t(disk.size()));
+    put_u32(&header, uint32_t(payload.size()));
+    put_u32(&header, crc);
+    if (fwrite(header.data(), 1, header.size(), f) != header.size() ||
+        fwrite(disk.data(), 1, disk.size(), f) != disk.size()) {
+      set_error("write failed");
+      return false;
+    }
+    payload.clear();
+    num_records = 0;
+    return true;
+  }
+};
+
+struct Scanner {
+  FILE* f = nullptr;
+  std::string chunk;     // decompressed payload of the current chunk
+  size_t pos = 0;        // read cursor within chunk
+  uint32_t remaining = 0;  // records left in current chunk
+  std::string record;    // last record returned (owned storage)
+
+  // Load the next chunk; returns false at EOF or on error (error set).
+  bool next_chunk() {
+    uint8_t hdr[kHeaderBytes];
+    size_t n = fread(hdr, 1, kHeaderBytes, f);
+    if (n == 0) return false;  // clean EOF
+    if (n != kHeaderBytes) {
+      set_error("truncated chunk header");
+      return false;
+    }
+    if (get_u32(hdr) != kMagic) {
+      set_error("bad chunk magic");
+      return false;
+    }
+    uint32_t num = get_u32(hdr + 4), flags = get_u32(hdr + 8);
+    uint32_t disk_bytes = get_u32(hdr + 12), raw_bytes = get_u32(hdr + 16);
+    uint32_t crc_expect = get_u32(hdr + 20);
+    std::string disk(disk_bytes, '\0');
+    if (fread(&disk[0], 1, disk_bytes, f) != disk_bytes) {
+      set_error("truncated chunk payload");
+      return false;
+    }
+    uint32_t crc =
+        crc32(0, reinterpret_cast<const Bytef*>(disk.data()), disk.size());
+    if (crc != crc_expect) {
+      set_error("chunk crc mismatch");
+      return false;
+    }
+    if (flags & kFlagCompressed) {
+      chunk.resize(raw_bytes);
+      uLongf dst = raw_bytes;
+      if (uncompress(reinterpret_cast<Bytef*>(&chunk[0]), &dst,
+                     reinterpret_cast<const Bytef*>(disk.data()),
+                     disk.size()) != Z_OK ||
+          dst != raw_bytes) {
+        set_error("zlib uncompress failed");
+        return false;
+      }
+    } else {
+      chunk.swap(disk);
+    }
+    pos = 0;
+    remaining = num;
+    return true;
+  }
+
+  const char* next(uint64_t* len) {
+    if (remaining == 0) {
+      g_last_error.clear();
+      if (!next_chunk()) {
+        *len = 0;
+        return nullptr;  // EOF or error (check rio_last_error)
+      }
+    }
+    if (pos + 4 > chunk.size()) {
+      set_error("corrupt chunk: record length out of range");
+      *len = 0;
+      return nullptr;
+    }
+    uint32_t rec_len = get_u32(reinterpret_cast<const uint8_t*>(chunk.data()) + pos);
+    pos += 4;
+    if (pos + rec_len > chunk.size()) {
+      set_error("corrupt chunk: record out of range");
+      *len = 0;
+      return nullptr;
+    }
+    record.assign(chunk, pos, rec_len);
+    pos += rec_len;
+    remaining--;
+    *len = rec_len;
+    return record.data();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* rio_last_error() { return g_last_error.c_str(); }
+
+void* rio_writer_open(const char* path, int compress, int max_chunk_bytes) {
+  FILE* f = fopen(path, "wb");
+  if (!f) {
+    set_error(std::string("cannot open for write: ") + path);
+    return nullptr;
+  }
+  Writer* w = new Writer();
+  w->f = f;
+  w->compress = compress != 0;
+  if (max_chunk_bytes > 0) w->max_chunk_bytes = size_t(max_chunk_bytes);
+  return w;
+}
+
+int rio_writer_write(void* wp, const char* data, uint64_t len) {
+  Writer* w = static_cast<Writer*>(wp);
+  put_u32(&w->payload, uint32_t(len));
+  w->payload.append(data, len);
+  w->num_records++;
+  w->total_records++;
+  if (w->payload.size() >= w->max_chunk_bytes) {
+    if (!w->flush_chunk()) return -1;
+  }
+  return 0;
+}
+
+// Returns total records written, or -1 on error.
+int64_t rio_writer_close(void* wp) {
+  Writer* w = static_cast<Writer*>(wp);
+  int64_t total = int64_t(w->total_records);
+  bool ok = w->flush_chunk();
+  if (fclose(w->f) != 0) ok = false;
+  delete w;
+  return ok ? total : -1;
+}
+
+void* rio_scanner_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    set_error(std::string("cannot open for read: ") + path);
+    return nullptr;
+  }
+  Scanner* s = new Scanner();
+  s->f = f;
+  return s;
+}
+
+const char* rio_scanner_next(void* sp, uint64_t* len) {
+  return static_cast<Scanner*>(sp)->next(len);
+}
+
+void rio_scanner_close(void* sp) {
+  Scanner* s = static_cast<Scanner*>(sp);
+  fclose(s->f);
+  delete s;
+}
+
+}  // extern "C"

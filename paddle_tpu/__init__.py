@@ -26,5 +26,7 @@ from . import io  # noqa
 from . import metrics  # noqa
 from . import profiler  # noqa
 from .parallel import ParallelExecutor  # noqa
+from . import reader  # noqa
+from .reader import batch  # noqa
 
 __version__ = "0.1.0"

@@ -1,0 +1,13 @@
+"""Dataset modules (reference: python/paddle/dataset/ — 14 corpora).
+
+Each module exposes creator functions returning readers (zero-arg callables
+yielding samples) with the reference's sample schemas; data is synthetic
+when the real corpus is not cached locally (see common.py).
+"""
+from . import (cifar, common, conll05, flowers, imdb, imikolov, mnist,
+               movielens, sentiment, uci_housing, voc2012, wmt14, wmt16)
+
+__all__ = [
+    "cifar", "common", "conll05", "flowers", "imdb", "imikolov", "mnist",
+    "movielens", "sentiment", "uci_housing", "voc2012", "wmt14", "wmt16",
+]

@@ -1,0 +1,34 @@
+"""CIFAR-10/100 (reference: python/paddle/dataset/cifar.py — 3072-dim
+float image in [0,1] + int label). Synthetic class-separable images."""
+import numpy as np
+
+from .common import rng_for
+
+
+def _make(name, split, n, num_classes):
+    def reader():
+        rng = rng_for(name, "templates")
+        templates = rng.rand(num_classes, 3072).astype(np.float32)
+        rng = rng_for(name, split)
+        labels = rng.randint(0, num_classes, n).astype(np.int64)
+        images = templates[labels] + 0.2 * rng.randn(n, 3072).astype(np.float32)
+        images = np.clip(images, 0, 1).astype(np.float32)
+        for i in range(n):
+            yield images[i], int(labels[i])
+    return reader
+
+
+def train10():
+    return _make("cifar10", "train", 4096, 10)
+
+
+def test10():
+    return _make("cifar10", "test", 512, 10)
+
+
+def train100():
+    return _make("cifar100", "train", 4096, 100)
+
+
+def test100():
+    return _make("cifar100", "test", 512, 100)

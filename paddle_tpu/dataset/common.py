@@ -1,0 +1,38 @@
+"""Dataset infrastructure.
+
+The reference's dataset modules download public corpora into a home cache
+(reference: python/paddle/dataset/common.py — DATA_HOME, download with md5
+verification). This environment has no network egress, so every dataset
+module here produces *deterministic synthetic data with the real schema*
+(same sample structure, dtypes, vocab semantics) unless the real files are
+already present under DATA_HOME, in which case they are loaded. Model code
+is agnostic to which path produced the samples.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def cache_path(*parts) -> str:
+    return os.path.join(DATA_HOME, *parts)
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def rng_for(name: str, split: str) -> np.random.RandomState:
+    """Deterministic per-(dataset, split) RNG for synthetic generation."""
+    seed = int.from_bytes(hashlib.sha256(
+        f"{name}:{split}".encode()).digest()[:4], "little")
+    return np.random.RandomState(seed)

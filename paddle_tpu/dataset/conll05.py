@@ -1,0 +1,52 @@
+"""CoNLL-2005 semantic role labeling (reference: python/paddle/dataset/
+conll05.py — sample = (word_seq, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2,
+verb_seq, mark_seq, label_seq) for label_semantic_roles). Synthetic
+sequences where labels depend on word/verb/mark so the CRF converges."""
+import numpy as np
+
+from .common import rng_for
+
+_WORD_VOCAB, _VERB_VOCAB, _NUM_LABELS = 2000, 100, 59  # ref label dict ~59
+
+
+def get_dict():
+    word_dict = {("w%d" % i): i for i in range(_WORD_VOCAB)}
+    verb_dict = {("v%d" % i): i for i in range(_VERB_VOCAB)}
+    label_dict = {("l%d" % i): i for i in range(_NUM_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    rng = rng_for("conll05", "emb")
+    return rng.randn(_WORD_VOCAB, 32).astype(np.float32)
+
+
+def _make(split, n):
+    def reader():
+        rng = rng_for("conll05", split)
+        label_of = rng_for("conll05", "rule").randint(
+            0, _NUM_LABELS, (_WORD_VOCAB, 2))
+        for _ in range(n):
+            length = int(rng.randint(5, 25))
+            words = rng.randint(0, _WORD_VOCAB, length)
+            verb = int(rng.randint(0, _VERB_VOCAB))
+            pred_pos = int(rng.randint(0, length))
+            mark = [1 if i == pred_pos else 0 for i in range(length)]
+            labels = [int(label_of[w, m]) for w, m in zip(words, mark)]
+            ctx = []
+            for off in (-2, -1, 0, 1, 2):
+                p = min(max(pred_pos + off, 0), length - 1)
+                ctx.append([int(words[p])] * length)
+            word_seq = [int(w) for w in words]
+            verb_seq = [verb] * length
+            yield (word_seq, ctx[0], ctx[1], ctx[2], ctx[3], ctx[4],
+                   verb_seq, mark, labels)
+    return reader
+
+
+def test():
+    return _make("test", 512)
+
+
+def train():
+    return _make("train", 2048)

@@ -1,0 +1,33 @@
+"""Oxford-102 flowers (reference: python/paddle/dataset/flowers.py —
+3x224x224 float image + label). Synthetic class-separable images."""
+import numpy as np
+
+from .common import rng_for
+
+_N_CLASSES = 102
+
+
+def _make(split, n):
+    def reader():
+        rng = rng_for("flowers", "templates")
+        templates = rng.rand(_N_CLASSES, 3, 8, 8).astype(np.float32)
+        rng = rng_for("flowers", split)
+        for _ in range(n):
+            label = int(rng.randint(0, _N_CLASSES))
+            base = np.kron(templates[label], np.ones((1, 28, 28),
+                                                     np.float32))
+            img = base + 0.1 * rng.randn(3, 224, 224).astype(np.float32)
+            yield np.clip(img, 0, 1).astype(np.float32), label
+    return reader
+
+
+def train(mapper=None, buffered_size=None, use_xmap=None):
+    return _make("train", 512)
+
+
+def test(mapper=None, buffered_size=None, use_xmap=None):
+    return _make("test", 64)
+
+
+def valid(mapper=None, buffered_size=None, use_xmap=None):
+    return _make("valid", 64)

@@ -1,0 +1,37 @@
+"""IMDB movie-review sentiment (reference: python/paddle/dataset/imdb.py —
+word-id sequence + binary label; word_dict built by frequency). Synthetic:
+two sentiment word populations so understand_sentiment converges."""
+import numpy as np
+
+from .common import rng_for
+
+_VOCAB = 5149  # reference IMDB cutoff-150 vocab is ~5148 words + <unk>
+
+
+def word_dict():
+    return {("w%d" % i): i for i in range(_VOCAB)}
+
+
+def _make(split, n, seq_lo=20, seq_hi=100):
+    def reader():
+        rng = rng_for("imdb", split)
+        half = _VOCAB // 2
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(seq_lo, seq_hi))
+            # positive reviews draw mostly from the upper half of the vocab
+            main = rng.randint(half, _VOCAB, length) if label else \
+                rng.randint(0, half, length)
+            noise_mask = rng.rand(length) < 0.1
+            noise = rng.randint(0, _VOCAB, length)
+            ids = np.where(noise_mask, noise, main).astype(np.int64)
+            yield list(map(int, ids)), label
+    return reader
+
+
+def train(word_idx=None):
+    return _make("train", 2048)
+
+
+def test(word_idx=None):
+    return _make("test", 256)

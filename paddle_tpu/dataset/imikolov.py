@@ -1,0 +1,55 @@
+"""PTB language-model corpus (reference: python/paddle/dataset/imikolov.py —
+n-gram tuples or sequence pairs from Penn Treebank). Synthetic Markov-ish
+id streams over a fixed vocab."""
+import numpy as np
+
+from .common import rng_for
+
+N = 5  # default n-gram order used by the word2vec book chapter
+_VOCAB = 2074  # reference build_dict(min_freq=50) size is ~2073 + <unk>
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def build_dict(min_word_freq: int = 50):
+    return {("w%d" % i): i for i in range(_VOCAB)}
+
+
+def _stream(split, length):
+    rng = rng_for("imikolov", split)
+    # order-1 Markov chain => n-grams are genuinely predictive
+    trans = rng.randint(0, _VOCAB, (_VOCAB, 4))
+    ids = np.empty(length, np.int64)
+    ids[0] = rng.randint(_VOCAB)
+    choices = rng.randint(0, 4, length)
+    noise = rng.rand(length) < 0.05
+    for i in range(1, length):
+        ids[i] = rng.randint(_VOCAB) if noise[i] else \
+            trans[ids[i - 1], choices[i]]
+    return ids
+
+
+def _make(split, word_idx, n, data_type, total):
+    def reader():
+        ids = _stream(split, total)
+        if data_type == DataType.NGRAM:
+            for i in range(len(ids) - n + 1):
+                yield tuple(int(w) for w in ids[i:i + n])
+        else:
+            sent_len = 20
+            for i in range(0, len(ids) - sent_len - 1, sent_len):
+                src = [int(w) for w in ids[i:i + sent_len]]
+                trg = [int(w) for w in ids[i + 1:i + sent_len + 1]]
+                yield src, trg
+    return reader
+
+
+def train(word_idx=None, n=N, data_type=DataType.NGRAM):
+    return _make("train", word_idx, n, data_type, 60000)
+
+
+def test(word_idx=None, n=N, data_type=DataType.NGRAM):
+    return _make("test", word_idx, n, data_type, 6000)

@@ -1,0 +1,75 @@
+"""MovieLens-1M ratings (reference: python/paddle/dataset/movielens.py —
+sample = [user_id, gender, age, job, movie_id, category_ids, title_ids,
+rating]). Synthetic users/movies with latent-factor ratings so
+recommender_system converges."""
+import numpy as np
+
+from .common import rng_for
+
+_N_USERS, _N_MOVIES = 944, 1683
+_N_CATEGORIES, _TITLE_VOCAB = 19, 1512
+_N_AGES, _N_JOBS = 7, 21
+_DIM = 8
+
+
+def max_user_id():
+    return _N_USERS - 1
+
+
+def max_movie_id():
+    return _N_MOVIES - 1
+
+
+def max_job_id():
+    return _N_JOBS - 1
+
+
+def age_table():
+    return [1, 18, 25, 35, 45, 50, 56]
+
+
+def movie_categories():
+    return {("cat%d" % i): i for i in range(_N_CATEGORIES)}
+
+
+def get_movie_title_dict():
+    return {("t%d" % i): i for i in range(_TITLE_VOCAB)}
+
+
+def _latents():
+    rng = rng_for("movielens", "latent")
+    u = rng.randn(_N_USERS, _DIM).astype(np.float32)
+    m = rng.randn(_N_MOVIES, _DIM).astype(np.float32)
+    return u, m
+
+
+def _make(split, n):
+    def reader():
+        u_lat, m_lat = _latents()
+        rng = rng_for("movielens", split)
+        meta = rng_for("movielens", "meta")
+        genders = meta.randint(0, 2, _N_USERS)
+        ages = meta.randint(0, _N_AGES, _N_USERS)
+        jobs = meta.randint(0, _N_JOBS, _N_USERS)
+        cats = [list(map(int, meta.randint(0, _N_CATEGORIES,
+                                           meta.randint(1, 4))))
+                for _ in range(_N_MOVIES)]
+        titles = [list(map(int, meta.randint(0, _TITLE_VOCAB,
+                                             meta.randint(2, 6))))
+                  for _ in range(_N_MOVIES)]
+        for _ in range(n):
+            u = int(rng.randint(_N_USERS))
+            m = int(rng.randint(_N_MOVIES))
+            score = float(u_lat[u] @ m_lat[m])
+            rating = float(np.clip(np.round(3.0 + score), 1, 5))
+            yield [u, int(genders[u]), int(ages[u]), int(jobs[u]),
+                   m, cats[m], titles[m], rating]
+    return reader
+
+
+def train():
+    return _make("train", 8192)
+
+
+def test():
+    return _make("test", 1024)

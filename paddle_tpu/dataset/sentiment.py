@@ -1,0 +1,15 @@
+"""Movie-review sentiment via NLTK corpus in the reference (reference:
+python/paddle/dataset/sentiment.py). Same schema as imdb: (ids, label)."""
+from . import imdb
+
+
+def get_word_dict():
+    return imdb.word_dict()
+
+
+def train():
+    return imdb._make("sentiment-train", 1024)
+
+
+def test():
+    return imdb._make("sentiment-test", 128)

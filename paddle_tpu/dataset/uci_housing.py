@@ -1,0 +1,33 @@
+"""UCI Housing regression dataset (reference:
+python/paddle/dataset/uci_housing.py — 13 features, scalar price).
+Synthetic: features ~ N(0,1), price = w.x + noise (fixed w), so fit_a_line
+converges the same way the real data does."""
+import numpy as np
+
+from .common import rng_for
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD", "TAX",
+    "PTRATIO", "B", "LSTAT",
+]
+
+_W = np.linspace(-1.0, 1.0, 13).astype(np.float32)
+
+
+def _make(split: str, n: int):
+    rng = rng_for("uci_housing", split)
+    x = rng.randn(n, 13).astype(np.float32)
+    y = (x @ _W + 0.1 * rng.randn(n)).astype(np.float32).reshape(n, 1)
+
+    def reader():
+        for i in range(n):
+            yield x[i], y[i]
+    return reader
+
+
+def train():
+    return _make("train", 404)
+
+
+def test():
+    return _make("test", 102)

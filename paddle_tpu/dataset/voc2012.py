@@ -1,0 +1,35 @@
+"""Pascal VOC2012 segmentation (reference: python/paddle/dataset/
+voc2012.py — (image, segmentation label map) pairs). Synthetic blobs."""
+import numpy as np
+
+from .common import rng_for
+
+_N_CLASSES = 21
+
+
+def _make(split, n, hw=64):
+    def reader():
+        rng = rng_for("voc2012", split)
+        for _ in range(n):
+            img = rng.rand(3, hw, hw).astype(np.float32)
+            label = np.zeros((hw, hw), np.int32)
+            for _ in range(3):
+                c = int(rng.randint(1, _N_CLASSES))
+                x0, y0 = rng.randint(0, hw - 8, 2)
+                w, h = rng.randint(4, 16, 2)
+                label[y0:y0 + h, x0:x0 + w] = c
+                img[:, y0:y0 + h, x0:x0 + w] += c / _N_CLASSES
+            yield np.clip(img, 0, 1), label
+    return reader
+
+
+def train():
+    return _make("train", 256)
+
+
+def test():
+    return _make("test", 32)
+
+
+def val():
+    return _make("val", 32)

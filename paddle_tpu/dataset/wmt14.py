@@ -1,0 +1,42 @@
+"""WMT14 fr→en translation pairs (reference: python/paddle/dataset/
+wmt14.py — sample = (src_ids, trg_ids, trg_ids_next) with <s>/<e>/<unk>).
+Synthetic invertible-mapping pairs so machine_translation learns."""
+import numpy as np
+
+from .common import rng_for
+
+START, END, UNK = 0, 1, 2
+_DICT = 1000  # reference default dict_size=30000; small synthetic vocab
+
+
+def _make(split, n, dict_size):
+    def reader():
+        rng = rng_for("wmt14", split)
+        # deterministic word-to-word mapping = a learnable translation
+        perm = rng_for("wmt14", "perm").permutation(dict_size - 3) + 3
+        for _ in range(n):
+            length = int(rng.randint(3, 12))
+            src = rng.randint(3, dict_size, length)
+            trg = perm[src - 3]
+            src_ids = [int(w) for w in src]
+            trg_ids = [START] + [int(w) for w in trg]
+            trg_next = [int(w) for w in trg] + [END]
+            yield src_ids, trg_ids, trg_next
+    return reader
+
+
+def train(dict_size=_DICT):
+    return _make("train", 4096, dict_size)
+
+
+def test(dict_size=_DICT):
+    return _make("test", 512, dict_size)
+
+
+def get_dict(dict_size=_DICT, reverse=False):
+    src = {("s%d" % i): i for i in range(dict_size)}
+    trg = {("t%d" % i): i for i in range(dict_size)}
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg

@@ -1,0 +1,19 @@
+"""WMT16 en↔de pairs (reference: python/paddle/dataset/wmt16.py — same
+(src, trg, trg_next) schema as wmt14 with configurable language pair)."""
+from . import wmt14
+from .common import rng_for
+
+START, END, UNK = wmt14.START, wmt14.END, wmt14.UNK
+
+
+def train(src_dict_size=1000, trg_dict_size=1000, src_lang="en"):
+    return wmt14._make("wmt16-train", 4096, min(src_dict_size, trg_dict_size))
+
+
+def test(src_dict_size=1000, trg_dict_size=1000, src_lang="en"):
+    return wmt14._make("wmt16-test", 512, min(src_dict_size, trg_dict_size))
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {("%s%d" % (lang, i)): i for i in range(dict_size)}
+    return {v: k for k, v in d.items()} if reverse else d

@@ -1,0 +1,71 @@
+"""ctypes binding to the native runtime library (native/*.cc).
+
+The reference binds its C++ runtime to Python with pybind11
+(reference: paddle/fluid/pybind/pybind.cc:74-185); pybind11 is not in this
+image, so the native layer exposes a C ABI and this module wraps it with
+ctypes. The library is built lazily via `make` on first import if missing.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libpaddle_tpu_native.so")
+
+_lib = None
+_lock = threading.Lock()
+
+
+def _build():
+    subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                   capture_output=True)
+
+
+def lib() -> ctypes.CDLL:
+    """Load (building if needed) the native library; idempotent."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            _build()
+        l = ctypes.CDLL(_LIB_PATH)
+
+        l.rio_last_error.restype = ctypes.c_char_p
+        l.rio_writer_open.restype = ctypes.c_void_p
+        l.rio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                      ctypes.c_int]
+        l.rio_writer_write.restype = ctypes.c_int
+        l.rio_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_uint64]
+        l.rio_writer_close.restype = ctypes.c_int64
+        l.rio_writer_close.argtypes = [ctypes.c_void_p]
+        l.rio_scanner_open.restype = ctypes.c_void_p
+        l.rio_scanner_open.argtypes = [ctypes.c_char_p]
+        l.rio_scanner_next.restype = ctypes.POINTER(ctypes.c_char)
+        l.rio_scanner_next.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_uint64)]
+        l.rio_scanner_close.argtypes = [ctypes.c_void_p]
+
+        l.dl_open.restype = ctypes.c_void_p
+        l.dl_open.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+                              ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+                              ctypes.c_int, ctypes.c_int]
+        l.dl_next.restype = ctypes.POINTER(ctypes.c_char)
+        l.dl_next.argtypes = [ctypes.c_void_p,
+                              ctypes.POINTER(ctypes.c_uint64)]
+        l.dl_error.restype = ctypes.c_char_p
+        l.dl_error.argtypes = [ctypes.c_void_p]
+        l.dl_close.argtypes = [ctypes.c_void_p]
+        _lib = l
+    return _lib
+
+
+def last_error() -> str:
+    return lib().rio_last_error().decode()

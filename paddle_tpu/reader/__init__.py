@@ -1,0 +1,232 @@
+"""Reader creators and decorators.
+
+Capability parity with the reference's reader library (reference:
+python/paddle/reader/decorator.py:29-236 — map_readers, shuffle, chain,
+compose, buffered, firstn, xmap_readers — and python/paddle/v2/minibatch.py
+`batch`). A reader is a zero-arg callable returning an iterator of samples;
+decorators wrap readers into new readers. `double_buffer` adds host-side
+prefetch (the reference implements this as a C++ reader op,
+operators/reader/create_double_buffer_reader_op.cc; here a background
+thread overlaps input with device compute, which JAX's async dispatch
+then overlaps with TPU execution).
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+from typing import Any, Callable, Iterable, List
+
+import numpy as np
+
+__all__ = [
+    "map_readers", "shuffle", "chain", "compose", "buffered", "firstn",
+    "xmap_readers", "batch", "double_buffer", "cache", "ComposeNotAligned",
+]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def map_readers(func: Callable, *readers):
+    """Apply func to the items of each reader, zipped."""
+    def reader():
+        rs = [r() for r in readers]
+        for items in zip(*rs):
+            yield func(*items)
+    return reader
+
+
+def shuffle(reader, buf_size: int, seed=None):
+    """Buffered shuffle: fill a buffer of buf_size samples, yield shuffled."""
+    def shuffled_reader():
+        rng = _random.Random(seed)
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                for s in buf:
+                    yield s
+                buf = []
+        if buf:
+            rng.shuffle(buf)
+            for s in buf:
+                yield s
+    return shuffled_reader
+
+
+def chain(*readers):
+    """Concatenate readers: all of r1's samples, then r2's, ..."""
+    def reader():
+        return itertools.chain(*[r() for r in readers])
+    return reader
+
+
+def compose(*readers, check_alignment: bool = True):
+    """Zip readers into tuples of their samples (flattening tuple samples)."""
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum((make_tuple(o) for o in outputs), ())
+    return reader
+
+
+class _ReaderError:
+    """Exception carrier: errors in producer threads re-raise in the
+    consumer rather than masquerading as end-of-data."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def buffered(reader, size: int):
+    """Background-thread buffer of up to `size` samples (prefetch)."""
+    _end = object()
+
+    def buffered_reader():
+        q: _queue.Queue = _queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+                q.put(_ReaderError(e))
+                return
+            q.put(_end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is _end:
+                return
+            if isinstance(s, _ReaderError):
+                raise s.exc
+            yield s
+    return buffered_reader
+
+
+def firstn(reader, n: int):
+    def firstn_reader():
+        return itertools.islice(reader(), n)
+    return firstn_reader
+
+
+def xmap_readers(mapper: Callable, reader, process_num: int,
+                 buffer_size: int, order: bool = False):
+    """Apply mapper with a pool of worker threads, optionally in order."""
+    _end = object()
+
+    def ordered_reader():
+        from concurrent.futures import ThreadPoolExecutor
+        pool = ThreadPoolExecutor(process_num)
+        futs: _queue.Queue = _queue.Queue(buffer_size)
+
+        def feed():
+            try:
+                for sample in reader():
+                    futs.put(pool.submit(mapper, sample))
+            except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+                futs.put(_ReaderError(e))
+                return
+            futs.put(_end)
+
+        threading.Thread(target=feed, daemon=True).start()
+        while True:
+            f = futs.get()
+            if f is _end or isinstance(f, _ReaderError):
+                pool.shutdown(wait=False)
+                if isinstance(f, _ReaderError):
+                    raise f.exc
+                return
+            yield f.result()
+
+    def unordered_reader():
+        in_q: _queue.Queue = _queue.Queue(buffer_size)
+        out_q: _queue.Queue = _queue.Queue(buffer_size)
+
+        def feed():
+            try:
+                for sample in reader():
+                    in_q.put(sample)
+            except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+                out_q.put(_ReaderError(e))
+            finally:
+                for _ in range(process_num):
+                    in_q.put(_end)
+
+        live = [process_num]
+        lock = threading.Lock()
+
+        def work():
+            while True:
+                sample = in_q.get()
+                if sample is _end:
+                    with lock:
+                        live[0] -= 1
+                        if live[0] == 0:
+                            out_q.put(_end)
+                    return
+                out_q.put(mapper(sample))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+        while True:
+            item = out_q.get()
+            if item is _end:
+                return
+            if isinstance(item, _ReaderError):
+                raise item.exc
+            yield item
+
+    return ordered_reader if order else unordered_reader
+
+
+def cache(reader):
+    """Materialize the reader on first call; replay from memory after.
+    Full materialization (not incremental append) so an abandoned first
+    iteration cannot corrupt the memo."""
+    memo: List[Any] = []
+    done = [False]
+
+    def cached_reader():
+        if not done[0]:
+            memo[:] = list(reader())
+            done[0] = True
+        return iter(memo)
+    return cached_reader
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """Group samples into lists of batch_size (reference: paddle.batch)."""
+    def batch_reader():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batch_reader
+
+
+def double_buffer(reader, size: int = 2):
+    """Prefetch decorated batches on a background thread so host input
+    assembly overlaps device compute."""
+    return buffered(reader, size)

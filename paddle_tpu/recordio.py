@@ -1,0 +1,128 @@
+"""Python API over the native recordio format (native/recordio.cc).
+
+Capability parity with the reference's recordio writer/scanner
+(reference: paddle/fluid/recordio/{writer,scanner}.h and the Python-side
+`fluid.recordio_writer`): chunked, checksummed, compressed record files
+that shard datasets for the native loader.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Iterable, Iterator, List, Optional
+
+from .native import lib, last_error
+
+
+class Writer:
+    def __init__(self, path: str, compress: bool = True,
+                 max_chunk_bytes: int = 1 << 20):
+        self._h = lib().rio_writer_open(path.encode(), int(compress),
+                                        max_chunk_bytes)
+        if not self._h:
+            raise IOError(last_error())
+
+    def write(self, record: bytes):
+        if self._h is None:
+            raise ValueError("write on closed Writer")
+        if lib().rio_writer_write(self._h, record, len(record)) != 0:
+            raise IOError(last_error())
+
+    def close(self) -> int:
+        """Flush and close; returns total records written."""
+        if self._h is None:
+            return 0
+        total = lib().rio_writer_close(self._h)
+        self._h = None
+        if total < 0:
+            raise IOError(last_error())
+        return int(total)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Scanner:
+    def __init__(self, path: str):
+        self._h = lib().rio_scanner_open(path.encode())
+        if not self._h:
+            raise IOError(last_error())
+
+    def __iter__(self) -> Iterator[bytes]:
+        n = ctypes.c_uint64()
+        while True:
+            if self._h is None:
+                raise ValueError("iterate on closed Scanner")
+            p = lib().rio_scanner_next(self._h, ctypes.byref(n))
+            if not p:
+                err = last_error()
+                if err:
+                    raise IOError(err)
+                return
+            yield ctypes.string_at(p, n.value)
+
+    def close(self):
+        if self._h is not None:
+            lib().rio_scanner_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_recordio(records: Iterable[bytes], path: str,
+                   compress: bool = True) -> int:
+    with Writer(path, compress=compress) as w:
+        for r in records:
+            w.write(r)
+        return w.close()
+
+
+def read_recordio(path: str) -> List[bytes]:
+    with Scanner(path) as s:
+        return list(s)
+
+
+class DataLoader:
+    """Multi-threaded prefetching loader over recordio shards
+    (native/loader.cc). Yields raw record bytes; compose with a decode fn
+    and `paddle_tpu.reader.batch` for training input."""
+
+    def __init__(self, paths: List[str], num_threads: int = 2,
+                 shuffle_buffer: int = 0, seed: int = 0, epochs: int = 1,
+                 queue_capacity: int = 1024):
+        self._paths = [p.encode() for p in paths]
+        arr = (ctypes.c_char_p * len(self._paths))(*self._paths)
+        self._h = lib().dl_open(arr, len(self._paths), num_threads,
+                                shuffle_buffer, seed, epochs, queue_capacity)
+        if not self._h:
+            raise IOError("dl_open failed")
+
+    def __iter__(self) -> Iterator[bytes]:
+        n = ctypes.c_uint64()
+        while True:
+            if self._h is None:
+                raise ValueError("iterate on closed DataLoader")
+            p = lib().dl_next(self._h, ctypes.byref(n))
+            if not p:
+                err = lib().dl_error(self._h).decode()
+                if err:
+                    raise IOError(err)
+                return
+            yield ctypes.string_at(p, n.value)
+
+    def close(self):
+        if self._h is not None:
+            lib().dl_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

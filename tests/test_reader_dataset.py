@@ -1,0 +1,100 @@
+"""Tests for reader decorators (reference: python/paddle/reader/
+tests/decorator_test.py) and dataset modules' schemas."""
+import numpy as np
+import pytest
+
+from paddle_tpu import reader
+from paddle_tpu import dataset
+
+
+def _counter(n):
+    def r():
+        return iter(range(n))
+    return r
+
+
+def test_map_readers():
+    got = list(reader.map_readers(lambda a, b: a + b,
+                                  _counter(5), _counter(5))())
+    assert got == [0, 2, 4, 6, 8]
+
+
+def test_shuffle_is_permutation():
+    got = list(reader.shuffle(_counter(100), buf_size=30, seed=3)())
+    assert sorted(got) == list(range(100))
+    assert got != list(range(100))
+
+
+def test_chain_compose_firstn():
+    assert list(reader.chain(_counter(2), _counter(3))()) == [0, 1, 0, 1, 2]
+    assert list(reader.compose(_counter(3), _counter(3))()) == [
+        (0, 0), (1, 1), (2, 2)]
+    with pytest.raises(reader.ComposeNotAligned):
+        list(reader.compose(_counter(3), _counter(4))())
+    assert list(reader.firstn(_counter(100), 3)()) == [0, 1, 2]
+
+
+def test_buffered_and_batch():
+    assert sorted(reader.buffered(_counter(50), 8)()) == list(range(50))
+    batches = list(reader.batch(_counter(10), 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+    batches = list(reader.batch(_counter(10), 3, drop_last=True)())
+    assert len(batches) == 3
+
+
+def test_xmap_readers():
+    for order in (True, False):
+        got = list(reader.xmap_readers(lambda x: x * 2, _counter(20),
+                                       process_num=4, buffer_size=8,
+                                       order=order)())
+        if order:
+            assert got == [2 * i for i in range(20)]
+        else:
+            assert sorted(got) == [2 * i for i in range(20)]
+
+
+def test_cache():
+    calls = [0]
+
+    def r():
+        calls[0] += 1
+        return iter(range(5))
+    c = reader.cache(r)
+    assert list(c()) == list(range(5))
+    assert list(c()) == list(range(5))
+    assert calls[0] == 1
+
+
+def test_uci_housing_schema():
+    s = next(dataset.uci_housing.train()())
+    assert s[0].shape == (13,) and s[1].shape == (1,)
+
+
+def test_mnist_schema_and_determinism():
+    a = list(reader.firstn(dataset.mnist.train(), 5)())
+    b = list(reader.firstn(dataset.mnist.train(), 5)())
+    assert all((x[0] == y[0]).all() and x[1] == y[1] for x, y in zip(a, b))
+    img, label = a[0]
+    assert img.shape == (784,) and 0 <= label < 10
+    assert img.min() >= -1 and img.max() <= 1
+
+
+def test_wmt14_schema():
+    src, trg, trg_next = next(dataset.wmt14.train()())
+    assert trg[0] == dataset.wmt14.START
+    assert trg_next[-1] == dataset.wmt14.END
+    assert trg[1:] == trg_next[:-1]
+
+
+def test_conll05_schema():
+    s = next(dataset.conll05.train()())
+    assert len(s) == 9
+    length = len(s[0])
+    assert all(len(x) == length for x in s)
+
+
+def test_movielens_schema():
+    s = next(dataset.movielens.train()())
+    assert len(s) == 8
+    assert isinstance(s[5], list) and isinstance(s[6], list)
+    assert 1 <= s[7] <= 5
