@@ -26,9 +26,10 @@ def _make(split, n):
         rng = rng_for("conll05", split)
         label_of = rng_for("conll05", "rule").randint(
             0, _NUM_LABELS, (_WORD_VOCAB, 2))
+        active = 400  # Zipf-like active vocab => learnable small corpus
         for _ in range(n):
             length = int(rng.randint(5, 25))
-            words = rng.randint(0, _WORD_VOCAB, length)
+            words = rng.randint(0, active, length)
             verb = int(rng.randint(0, _VERB_VOCAB))
             pred_pos = int(rng.randint(0, length))
             mark = [1 if i == pred_pos else 0 for i in range(length)]

@@ -16,12 +16,13 @@ def _make(split, n, seq_lo=20, seq_hi=100):
     def reader():
         rng = rng_for("imdb", split)
         half = _VOCAB // 2
+        active = 400  # Zipf-like active vocab per sentiment class
         for _ in range(n):
             label = int(rng.randint(0, 2))
             length = int(rng.randint(seq_lo, seq_hi))
             # positive reviews draw mostly from the upper half of the vocab
-            main = rng.randint(half, _VOCAB, length) if label else \
-                rng.randint(0, half, length)
+            main = rng.randint(half, half + active, length) if label else \
+                rng.randint(0, active, length)
             noise_mask = rng.rand(length) < 0.1
             noise = rng.randint(0, _VOCAB, length)
             ids = np.where(noise_mask, noise, main).astype(np.int64)

@@ -19,16 +19,19 @@ def build_dict(min_word_freq: int = 50):
 
 
 def _stream(split, length):
+    # order-1 Markov chain over a Zipf-like active vocab => n-grams are
+    # genuinely predictive and learnable from a small corpus
+    _ACTIVE = 300
+    rng = rng_for("imikolov", "trans")
+    trans = rng.randint(0, _ACTIVE, (_ACTIVE, 2))
     rng = rng_for("imikolov", split)
-    # order-1 Markov chain => n-grams are genuinely predictive
-    trans = rng.randint(0, _VOCAB, (_VOCAB, 4))
     ids = np.empty(length, np.int64)
-    ids[0] = rng.randint(_VOCAB)
-    choices = rng.randint(0, 4, length)
+    ids[0] = rng.randint(_ACTIVE)
+    choices = rng.randint(0, 2, length)
     noise = rng.rand(length) < 0.05
     for i in range(1, length):
         ids[i] = rng.randint(_VOCAB) if noise[i] else \
-            trans[ids[i - 1], choices[i]]
+            trans[ids[i - 1] % _ACTIVE, choices[i]]
     return ids
 
 

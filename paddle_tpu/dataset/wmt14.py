@@ -12,11 +12,13 @@ _DICT = 1000  # reference default dict_size=30000; small synthetic vocab
 def _make(split, n, dict_size):
     def reader():
         rng = rng_for("wmt14", split)
-        # deterministic word-to-word mapping = a learnable translation
+        # deterministic word-to-word mapping = a learnable translation;
+        # Zipf-like active vocab keeps the task learnable from a small corpus
+        active = min(300, dict_size - 3)
         perm = rng_for("wmt14", "perm").permutation(dict_size - 3) + 3
         for _ in range(n):
             length = int(rng.randint(3, 12))
-            src = rng.randint(3, dict_size, length)
+            src = rng.randint(3, 3 + active, length)
             trg = perm[src - 3]
             src_ids = [int(w) for w in src]
             trg_ids = [START] + [int(w) for w in trg]

@@ -141,7 +141,8 @@ class LayerHelper:
         b = self.create_parameter(bias_attr, shape=[int(size)],
                                   dtype=input_var.dtype, is_bias=True)
         out = self.create_tmp_variable(input_var.dtype,
-                                       lod_level=input_var.lod_level)
+                                       lod_level=input_var.lod_level,
+                                       shape=input_var.shape)
         self.append_op(type="elementwise_add",
                        inputs={"X": input_var, "Y": b},
                        outputs={"Out": out}, attrs={"axis": -1})
@@ -158,7 +159,8 @@ class LayerHelper:
             act_type = act
             attrs = {}
         out = self.create_tmp_variable(input_var.dtype,
-                                       lod_level=input_var.lod_level)
+                                       lod_level=input_var.lod_level,
+                                       shape=input_var.shape)
         self.append_op(type=act_type, inputs={"X": input_var},
                        outputs={"Out": out}, attrs=attrs)
         return out

@@ -34,6 +34,7 @@ __all__ = [
     "hinge_loss", "edit_distance", "pad2d", "leaky_relu", "elu", "pow",
     "swish", "hard_sigmoid", "relu6", "soft_relu", "flatten", "gelu",
     "beam_search", "beam_search_decode", "increment", "cumsum",
+    "linear_chain_crf", "crf_decoding",
 ]
 
 
@@ -53,7 +54,9 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
             flat_dim *= int(d)
         w = helper.create_parameter(helper.param_attr,
                                     shape=[flat_dim, size], dtype=dtype)
-        tmp = helper.create_tmp_variable(dtype, lod_level=inp.lod_level)
+        out_shape = list(in_shape[:num_flatten_dims]) + [size]
+        tmp = helper.create_tmp_variable(dtype, lod_level=inp.lod_level,
+                                         shape=out_shape)
         helper.append_op(type="mul", inputs={"X": inp, "Y": w},
                          outputs={"Out": tmp},
                          attrs={"x_num_col_dims": num_flatten_dims
@@ -102,10 +105,12 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
     b = helper.create_parameter(helper.bias_attr or ParamAttr(),
                                 shape=[1, bias_size], dtype=dtype,
                                 is_bias=True)
-    hidden = helper.create_tmp_variable(dtype, lod_level=1)
-    cell = helper.create_tmp_variable(dtype, lod_level=1)
-    last_h = helper.create_tmp_variable(dtype)
-    last_c = helper.create_tmp_variable(dtype)
+    hidden = helper.create_tmp_variable(dtype, lod_level=1,
+                                        shape=[-1, hidden_size])
+    cell = helper.create_tmp_variable(dtype, lod_level=1,
+                                      shape=[-1, hidden_size])
+    last_h = helper.create_tmp_variable(dtype, shape=[-1, hidden_size])
+    last_c = helper.create_tmp_variable(dtype, shape=[-1, hidden_size])
     inputs = {"Input": input, "Weight": w, "Bias": b}
     if h_0 is not None:
         inputs["H0"] = h_0
@@ -131,8 +136,9 @@ def dynamic_gru(input, size, param_attr=None, bias_attr=None, h_0=None,
     b = helper.create_parameter(helper.bias_attr or ParamAttr(),
                                 shape=[1, 3 * size], dtype=dtype,
                                 is_bias=True)
-    hidden = helper.create_tmp_variable(dtype, lod_level=1)
-    last_h = helper.create_tmp_variable(dtype)
+    hidden = helper.create_tmp_variable(dtype, lod_level=1,
+                                        shape=[-1, size])
+    last_h = helper.create_tmp_variable(dtype, shape=[-1, size])
     inputs = {"Input": input, "Weight": w, "Bias": b}
     if h_0 is not None:
         inputs["H0"] = h_0
@@ -902,7 +908,12 @@ def warpctc(input, label, blank=0, norm_by_times=False):
 def scaled_dot_product_attention(queries, keys, values, num_heads=1,
                                  dropout_rate=0.0):
     helper = LayerHelper("scaled_dot_product_attention")
-    out = helper.create_tmp_variable(queries.dtype)
+    shape = None
+    if queries.shape is not None and values.shape is not None:
+        shape = list(queries.shape[:-1]) + [values.shape[-1]]
+    out = helper.create_tmp_variable(queries.dtype,
+                                     lod_level=queries.lod_level,
+                                     shape=shape)
     helper.append_op(type="scaled_dot_product_attention",
                      inputs={"Q": queries, "K": keys, "V": values},
                      outputs={"Out": out})
@@ -937,3 +948,48 @@ def beam_search_decode(ids, scores, beam_size, end_id):
                               "SentenceScores": sentence_scores},
                      attrs={"beam_size": beam_size, "end_id": end_id})
     return sentence_ids, sentence_scores
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """Linear-chain CRF negative log-likelihood over ragged sequences
+    (reference: layers/nn.py linear_chain_crf / linear_chain_crf_op.cc).
+    Creates the [num_tags+2, num_tags] transition parameter (rows 0/1 =
+    start/end weights)."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    num_tags = int(input.shape[-1])
+    transition = helper.create_parameter(
+        helper.param_attr, shape=[num_tags + 2, num_tags],
+        dtype=input.dtype)
+    ll = helper.create_tmp_variable(input.dtype)
+    alpha = helper.create_tmp_variable(input.dtype)
+    em_exps = helper.create_tmp_variable(input.dtype)
+    tr_exps = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="linear_chain_crf",
+                     inputs={"Emission": input, "Label": label,
+                             "Transition": transition},
+                     outputs={"LogLikelihood": ll, "Alpha": alpha,
+                              "EmissionExps": em_exps,
+                              "TransitionExps": tr_exps})
+    return ll
+
+
+def crf_decoding(input, param_attr=None, label=None):
+    """Viterbi decode with a trained CRF transition parameter (reference:
+    layers/nn.py crf_decoding / crf_decoding_op.h). With `label`, emits
+    per-position 0/1 correctness instead of the path."""
+    helper = LayerHelper("crf_decoding", param_attr=param_attr)
+    attr = helper.param_attr
+    if attr is not None and attr.name is not None:
+        # Share the transition parameter trained by linear_chain_crf.
+        transition = helper.main_program.global_block().var(attr.name)
+    else:
+        num_tags = int(input.shape[-1])
+        transition = helper.create_parameter(
+            attr, shape=[num_tags + 2, num_tags], dtype=input.dtype)
+    path = helper.create_tmp_variable("int64", lod_level=input.lod_level)
+    inputs = {"Emission": input, "Transition": transition}
+    if label is not None:
+        inputs["Label"] = label
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": path})
+    return path

@@ -58,7 +58,16 @@ def cast(x, dtype):
 
 def concat(input: Sequence[Variable], axis: int = 0, name=None):
     helper = LayerHelper("concat", name=name)
-    out = helper.create_tmp_variable(input[0].dtype)
+    shape = None
+    if all(v.shape is not None for v in input):
+        shape = list(input[0].shape)
+        ax = axis if axis >= 0 else len(shape) + axis
+        dims = [v.shape[ax] for v in input]
+        shape[ax] = -1 if any(d is None or d < 0 for d in dims) \
+            else sum(dims)
+    out = helper.create_tmp_variable(input[0].dtype,
+                                     lod_level=input[0].lod_level,
+                                     shape=shape)
     helper.append_op(type="concat", inputs={"X": list(input)},
                      outputs={"Out": out}, attrs={"axis": axis})
     return out

@@ -12,6 +12,7 @@ from . import sequence_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import metric_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
+from . import crf_ops  # noqa: F401
 
 from ..core.registry import OpRegistry
 
