@@ -411,16 +411,37 @@ def _unstack(ctx):
     ctx.set_outputs("Y", [p.squeeze(axis) for p in parts])
 
 
-@register_op("scaled_dot_product_attention")
+@register_op("scaled_dot_product_attention", no_grad_slots=["Mask"])
 def _sdpa(ctx):
     """Fused attention (TPU-native addition; the reference composes it from
-    matmul/softmax in python/paddle/fluid/nets.py:312)."""
+    matmul/softmax in python/paddle/fluid/nets.py:312).
+
+    Large shapes on TPU route to the Pallas flash-attention kernel
+    (ops/pallas/flash_attention.py) — O(S) memory, online softmax; small
+    shapes use the naive composition, which XLA fuses fine. Mask is a
+    constant (no_grad_slots) on both paths; a *trainable* additive bias
+    should call ops.pallas.flash_attention(bias_grad=True) directly.
+    """
     q, k, v = ctx.input("Q"), ctx.input("K"), ctx.input("V")
     mask = ctx.input("Mask")
+    causal = bool(ctx.attr("causal", False))
+    use_flash = ctx.attr("use_flash", None)
+    if use_flash is None:
+        use_flash = (jax.default_backend() == "tpu" and q.ndim == 4
+                     and q.shape[2] >= 128 and k.shape[2] >= 128)
+    if use_flash:
+        from .pallas import flash_attention
+        ctx.set_output("Out", flash_attention(q, k, v, mask, causal=causal))
+        return
     scale = 1.0 / np.sqrt(q.shape[-1])
     scores = jnp.einsum("...qd,...kd->...qk", q, k) * scale
     if mask is not None:
         scores = scores + mask
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        qpos = jnp.arange(sq)[:, None]
+        kpos = jnp.arange(sk)[None, :]
+        scores = jnp.where(qpos >= kpos, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     ctx.set_output("Out", jnp.einsum("...qk,...kd->...qd", probs, v))
 

@@ -1,0 +1,12 @@
+"""Pallas TPU kernels for hot ops.
+
+The reference hand-writes CUDA for its fused hot ops (fused LSTM
+paddle/cuda/src/hl_cuda_lstm.cu, top-k cuda/src/hl_top_k.cu, attention-era
+compositions in nets.py). The TPU-native analogue is a small library of
+Pallas kernels; everything else rides XLA fusion.
+
+All kernels run in interpret mode on CPU (tests) and compiled on TPU.
+"""
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]
