@@ -122,6 +122,15 @@ def _prune(program: Program, feed_names, fetch_names) -> Program:
     (reference: framework/prune.cc)."""
     pruned = program.clone()
     block = pruned.desc.global_block
+
+    def _persistable(name: str) -> bool:
+        v = block.find_var_recursive(name)
+        return v is not None and v.persistable
+
+    # Backward walk from the fetch targets. Persistable vars (parameters)
+    # are roots: their values come from the loaded checkpoint, so their
+    # producers (optimizer update ops, which *output* the param) must not
+    # pull the training graph back in.
     needed = set(fetch_names)
     keep = []
     for op in reversed(block.ops):
@@ -130,7 +139,8 @@ def _prune(program: Program, feed_names, fetch_names) -> Program:
         if any(n in needed for n in op.output_names()):
             keep.append(op)
             for n in op.input_names():
-                needed.add(n)
+                if not _persistable(n):
+                    needed.add(n)
     block.ops = list(reversed(keep))
     pruned.desc._bump_version()
     return pruned

@@ -979,11 +979,14 @@ def crf_decoding(input, param_attr=None, label=None):
     per-position 0/1 correctness instead of the path."""
     helper = LayerHelper("crf_decoding", param_attr=param_attr)
     attr = helper.param_attr
-    if attr is not None and attr.name is not None:
+    num_tags = int(input.shape[-1])
+    if attr is not None and attr.name is not None and \
+            helper.main_program.global_block().has_var(attr.name):
         # Share the transition parameter trained by linear_chain_crf.
         transition = helper.main_program.global_block().var(attr.name)
     else:
-        num_tags = int(input.shape[-1])
+        # Decode-only/inference programs create it fresh (it is then
+        # loaded from a checkpoint by name).
         transition = helper.create_parameter(
             attr, shape=[num_tags + 2, num_tags], dtype=input.dtype)
     path = helper.create_tmp_variable("int64", lod_level=input.lod_level)

@@ -59,12 +59,18 @@ def cast(x, dtype):
 def concat(input: Sequence[Variable], axis: int = 0, name=None):
     helper = LayerHelper("concat", name=name)
     shape = None
-    if all(v.shape is not None for v in input):
+    ranks = {len(v.shape) for v in input if v.shape is not None}
+    if len(ranks) == 1 and all(v.shape is not None for v in input):
         shape = list(input[0].shape)
         ax = axis if axis >= 0 else len(shape) + axis
-        dims = [v.shape[ax] for v in input]
-        shape[ax] = -1 if any(d is None or d < 0 for d in dims) \
-            else sum(dims)
+        if 0 <= ax < len(shape):
+            dims = [v.shape[ax] for v in input]
+            shape[ax] = -1 if any(d is None or d < 0 for d in dims) \
+                else sum(dims)
+        else:
+            # Declared shapes are loose metadata (ragged vars declare 2D);
+            # leave it to the runtime op when the axis is out of range.
+            shape = None
     out = helper.create_tmp_variable(input[0].dtype,
                                      lod_level=input[0].lod_level,
                                      shape=shape)

@@ -12,16 +12,11 @@ reference hand-derives the backward kernel.
 """
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 
 from ..core.lod import RaggedPair
-from ..core.registry import register_op
-from .sequence_ops import _as_ragged
-
-register_op_SEQ = partial(register_op, ragged_aware=True)
+from .sequence_ops import _as_ragged, register_op_SEQ
 
 
 def _crf_components(transition):

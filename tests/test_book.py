@@ -198,6 +198,12 @@ def test_machine_translation(tmp_path):
     exe, losses = _train(main, startup, feeds(), loss, 50)
     assert losses[-1] < losses[0] * 0.9
 
+    fd = next(feeds())
+    (direct,) = exe.run(main, feed=fd, fetch_list=[logits])
+    ref = np.asarray(direct.data if hasattr(direct, "data") else direct)
+    _roundtrip(tmp_path, exe, main, ["src", "trg"], [logits],
+               {"src": fd["src"], "trg": fd["trg"]}, ref.shape)
+
 
 def test_label_semantic_roles(tmp_path):
     # SRL with CRF (reference: test_label_semantic_roles.py)
@@ -247,6 +253,10 @@ def test_label_semantic_roles(tmp_path):
     (decoded,) = exe.run(main, feed=fd, fetch_list=[path])
     arr = decoded.data if hasattr(decoded, "data") else decoded
     assert np.asarray(arr).min() >= 0 and np.asarray(arr).max() < ln
+
+    _roundtrip(tmp_path, exe, main, ["word", "verb", "mark"], [path],
+               {k: fd[k] for k in ("word", "verb", "mark")},
+               np.asarray(arr).shape)
 
 
 def test_recommender_system(tmp_path):
@@ -301,6 +311,11 @@ def test_recommender_system(tmp_path):
     exe, losses = _train(main, startup, feeds(), loss, 30)
     assert losses[-1] < losses[0] * 0.8
 
+    fd = next(feeds())
+    infer_feed = {k: v for k, v in fd.items() if k != "rating"}
+    _roundtrip(tmp_path, exe, main, list(infer_feed), [pred], infer_feed,
+               (32, 1))
+
 
 def test_understand_sentiment(tmp_path):
     # conv + lstm text classification (reference:
@@ -334,3 +349,7 @@ def test_understand_sentiment(tmp_path):
                        "label": np.array([[s[1]] for s in b], np.int64)}
     exe, losses = _train(main, startup, feeds(), loss, 50)
     assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.8
+
+    fd = next(feeds())
+    _roundtrip(tmp_path, exe, main, ["words"], [logits],
+               {"words": fd["words"]}, (16, 2))
