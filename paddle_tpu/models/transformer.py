@@ -12,7 +12,8 @@ from ..layer_helper import LayerHelper
 
 
 def multi_head_attention(q_in, k_in, v_in, d_model, n_head, mask=None,
-                         dropout_rate=0.0):
+                         dropout_rate=0.0, causal=False, seq_axis=None,
+                         seq_impl="ring"):
     d_key = d_model // n_head
     q = layers.fc(q_in, size=d_model, num_flatten_dims=2, bias_attr=False)
     k = layers.fc(k_in, size=d_model, num_flatten_dims=2, bias_attr=False)
@@ -29,8 +30,13 @@ def multi_head_attention(q_in, k_in, v_in, d_model, n_head, mask=None,
     inputs = {"Q": qh, "K": kh, "V": vh}
     if mask is not None:
         inputs["Mask"] = mask
+    attrs = {"causal": causal}
+    if seq_axis:
+        # context parallelism over the named mesh axis (ring/ulysses)
+        attrs["seq_axis"] = seq_axis
+        attrs["seq_impl"] = seq_impl
     helper.append_op(type="scaled_dot_product_attention", inputs=inputs,
-                     outputs={"Out": ctx_v})
+                     outputs={"Out": ctx_v}, attrs=attrs)
     merged = layers.transpose(ctx_v, [0, 2, 1, 3])
     merged = layers.reshape(merged, [0, 0, d_model])
     out = layers.fc(merged, size=d_model, num_flatten_dims=2,
@@ -52,17 +58,21 @@ def _add_norm(x, y, d_model):
                              begin_norm_axis=2)
 
 
-def encoder_layer(x, d_model, n_head, d_inner, mask=None, dropout=0.0):
-    attn = multi_head_attention(x, x, x, d_model, n_head, mask, dropout)
+def encoder_layer(x, d_model, n_head, d_inner, mask=None, dropout=0.0,
+                  seq_axis=None, seq_impl="ring"):
+    attn = multi_head_attention(x, x, x, d_model, n_head, mask, dropout,
+                                seq_axis=seq_axis, seq_impl=seq_impl)
     x = _add_norm(x, attn, d_model)
     f = ffn(x, d_model, d_inner, dropout)
     return _add_norm(x, f, d_model)
 
 
 def decoder_layer(x, enc_out, d_model, n_head, d_inner, self_mask=None,
-                  cross_mask=None, dropout=0.0):
+                  cross_mask=None, dropout=0.0, self_causal=False,
+                  seq_axis=None, seq_impl="ring"):
     self_attn = multi_head_attention(x, x, x, d_model, n_head, self_mask,
-                                     dropout)
+                                     dropout, causal=self_causal,
+                                     seq_axis=seq_axis, seq_impl=seq_impl)
     x = _add_norm(x, self_attn, d_model)
     cross = multi_head_attention(x, enc_out, enc_out, d_model, n_head,
                                  cross_mask, dropout)
@@ -100,20 +110,35 @@ def _pad_attn_mask(ids, pad_id=0):
 def transformer(src_ids, trg_ids, trg_labels, pos_src, pos_trg,
                 src_vocab=10000, trg_vocab=10000, max_len=64, n_layer=2,
                 n_head=8, d_model=512, d_inner=2048, dropout=0.0,
-                causal_mask=None, pad_id=0):
+                causal_mask=None, pad_id=0, seq_axis=None,
+                seq_impl="ring"):
     src_mask = _pad_attn_mask(src_ids, pad_id)
     enc = embed(src_ids, src_vocab, d_model, max_len, pos_src)
     for _ in range(n_layer):
         enc = encoder_layer(enc, d_model, n_head, d_inner, src_mask,
-                            dropout)
+                            dropout, seq_axis=seq_axis, seq_impl=seq_impl)
     dec = embed(trg_ids, trg_vocab, d_model, max_len, pos_trg)
-    self_mask = causal_mask
-    if causal_mask is not None:
-        trg_mask = _pad_attn_mask(trg_ids, pad_id)
-        self_mask = layers.elementwise_add(trg_mask, causal_mask)
+    if seq_axis:
+        if causal_mask is not None:
+            raise ValueError(
+                "seq_axis and causal_mask are mutually exclusive: ring "
+                "attention cannot consume a dense [Sq,Sk] bias; causality "
+                "is expressed via the op's 'causal' attr on the CP path")
+        # CP path: causality is an attr (ring-compatible); the pad mask
+        # stays a key-row mask that rotates with its K/V block.
+        self_mask = _pad_attn_mask(trg_ids, pad_id)
+        self_causal = True
+    else:
+        self_causal = False
+        self_mask = causal_mask
+        if causal_mask is not None:
+            trg_mask = _pad_attn_mask(trg_ids, pad_id)
+            self_mask = layers.elementwise_add(trg_mask, causal_mask)
     for _ in range(n_layer):
         dec = decoder_layer(dec, enc, d_model, n_head, d_inner,
-                            self_mask, src_mask, dropout)
+                            self_mask, src_mask, dropout,
+                            self_causal=self_causal, seq_axis=seq_axis,
+                            seq_impl=seq_impl)
     logits = layers.fc(dec, size=trg_vocab, num_flatten_dims=2)
     tok_loss = layers.softmax_with_cross_entropy(logits, trg_labels)
     # Average only over non-pad target positions.
@@ -129,7 +154,8 @@ def transformer(src_ids, trg_ids, trg_labels, pos_src, pos_trg,
 
 
 def build_train(src_vocab=10000, trg_vocab=10000, max_len=64, n_layer=2,
-                n_head=8, d_model=512, d_inner=2048, lr=1e-3):
+                n_head=8, d_model=512, d_inner=2048, lr=1e-3,
+                seq_axis=None, seq_impl="ring"):
     import paddle_tpu as pt
     main, startup = pt.Program(), pt.Program()
     with pt.program_guard(main, startup):
@@ -138,11 +164,15 @@ def build_train(src_vocab=10000, trg_vocab=10000, max_len=64, n_layer=2,
         lbl = layers.data("trg_labels", [max_len, 1], dtype="int64")
         pos = layers.data("pos_ids", [max_len], dtype="int64",
                           append_batch_size=False)
-        causal = layers.assign(
-            np.triu(np.full((max_len, max_len), -1e9, np.float32), k=1))
+        causal = None
+        if not seq_axis:
+            causal = layers.assign(
+                np.triu(np.full((max_len, max_len), -1e9, np.float32),
+                        k=1))
         loss, logits = transformer(src, trg, lbl, pos, pos, src_vocab,
                                    trg_vocab, max_len, n_layer, n_head,
                                    d_model, d_inner,
-                                   causal_mask=causal)
+                                   causal_mask=causal, seq_axis=seq_axis,
+                                   seq_impl=seq_impl)
         opt.AdamOptimizer(learning_rate=lr).minimize(loss)
     return main, startup, {"loss": loss}

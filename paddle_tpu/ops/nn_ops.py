@@ -425,6 +425,32 @@ def _sdpa(ctx):
     q, k, v = ctx.input("Q"), ctx.input("K"), ctx.input("V")
     mask = ctx.input("Mask")
     causal = bool(ctx.attr("causal", False))
+
+    # Sequence/context parallelism: attr seq_axis names a mesh axis the
+    # sequence dim is sharded over (parallel/context_parallel.py).
+    seq_axis = ctx.attr("seq_axis", None)
+    mesh = ctx.extra.get("mesh") if ctx.extra else None
+    if seq_axis and mesh is not None and seq_axis in mesh.axis_names:
+        from ..parallel.context_parallel import sequence_parallel_attention
+        kv_mask = None
+        if mask is not None:
+            if mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
+                kv_mask = mask[:, 0, 0, :]        # [b, Sk] key-row mask
+            elif mask.ndim == 2:
+                kv_mask = mask
+            else:
+                raise ValueError(
+                    "sequence-parallel attention supports key-row masks "
+                    "([b,1,1,Sk]); express causality via attr 'causal', "
+                    f"got mask shape {mask.shape}")
+        ctx.set_output("Out", sequence_parallel_attention(
+            q, k, v, mesh, axis=seq_axis,
+            impl=ctx.attr("seq_impl", "ring"), causal=causal,
+            kv_mask=kv_mask,
+            batch_axis=ctx.attr("batch_axis", "data"),
+            head_axis=ctx.attr("head_axis", "model")))
+        return
+
     use_flash = ctx.attr("use_flash", None)
     if use_flash is None:
         use_flash = (jax.default_backend() == "tpu" and q.ndim == 4

@@ -1,2 +1,4 @@
 from .mesh import get_mesh, make_mesh, mesh_shape  # noqa: F401
 from .executor import ParallelExecutor  # noqa: F401
+from .context_parallel import (  # noqa: F401
+    ring_attention, sequence_parallel_attention, ulysses_attention)

@@ -1,0 +1,128 @@
+"""Ring attention + Ulysses sequence parallelism on an 8-device CPU mesh,
+validated against single-device attention (values AND gradients)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.parallel import make_mesh
+from paddle_tpu.parallel.context_parallel import (
+    ring_attention, sequence_parallel_attention, ulysses_attention)
+
+
+def naive(q, k, v, causal=False):
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / np.sqrt(d)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        m = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(m[None, None], s, -1e30)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(p.dtype)).astype(q.dtype)
+
+
+def _qkv(B=2, H=8, S=64, D=16):
+    rng = np.random.RandomState(0)
+    mk = lambda s: jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+    return mk(0), mk(1), mk(2)
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_sequence_parallel_matches_single_device(impl, causal):
+    assert len(jax.devices()) == 8, "conftest must provide 8 CPU devices"
+    mesh = make_mesh((8,), ("seq",))
+    q, k, v = _qkv()
+    out = sequence_parallel_attention(q, k, v, mesh, axis="seq",
+                                      impl=impl, causal=causal)
+    ref = naive(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_sequence_parallel_grads(impl):
+    mesh = make_mesh((8,), ("seq",))
+    q, k, v = _qkv(B=1, H=8, S=32, D=8)
+
+    def loss_sp(q, k, v):
+        o = sequence_parallel_attention(q, k, v, mesh, axis="seq",
+                                        impl=impl, causal=True)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(naive(q, k, v, causal=True)))
+
+    g1 = jax.grad(loss_sp, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_kv_padding_mask(impl):
+    """Key-row padding masks rotate with their K/V block (ring) or are
+    all-gathered (ulysses)."""
+    mesh = make_mesh((8,), ("seq",))
+    q, k, v = _qkv(B=2, H=8, S=64, D=16)
+    rng = np.random.RandomState(7)
+    kv_mask = jnp.asarray(
+        np.where(rng.rand(2, 64) < 0.2, -1e9, 0.0), jnp.float32)
+    out = sequence_parallel_attention(q, k, v, mesh, impl=impl,
+                                      kv_mask=kv_mask)
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    s = s + kv_mask[:, None, None, :]
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_transformer_trains_with_context_parallel():
+    """Whole-program integration: transformer train step with seq_axis
+    through the IR + ParallelExecutor on a (data, seq) mesh."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+    from paddle_tpu.parallel.executor import ParallelExecutor, ShardingSpec
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh((2, 4), ("data", "seq"))
+    max_len = 8
+    main, startup, f = transformer.build_train(
+        src_vocab=64, trg_vocab=64, max_len=max_len, n_layer=1,
+        n_head=4, d_model=16, d_inner=32, lr=1e-2, seq_axis="seq")
+    sharding = ShardingSpec(feed_axis="data")
+    sharding.specs["pos_ids"] = P()
+    exe = ParallelExecutor(mesh=mesh, sharding=sharding)
+    pt.Executor().run(startup)
+    rng = np.random.RandomState(0)
+    feed = {
+        "src_ids": rng.randint(1, 64, (4, max_len, 1)).astype(np.int64),
+        "trg_ids": rng.randint(1, 64, (4, max_len, 1)).astype(np.int64),
+        "trg_labels": rng.randint(1, 64, (4, max_len, 1)).astype(np.int64),
+        "pos_ids": np.arange(max_len).astype(np.int64),
+    }
+    losses = []
+    for _ in range(15):
+        (l,) = exe.run(main, feed=feed, fetch_list=[f["loss"]])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.9, (losses[0], losses[-1])
+
+
+def test_ring_attention_under_jit_with_sharded_inputs():
+    """End-to-end under jit: sequence-sharded device arrays in, the ring
+    rides ppermute (no gather back to one device)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = make_mesh((8,), ("seq",))
+    q, k, v = _qkv(B=1, H=2, S=128, D=8)
+    sh = NamedSharding(mesh, P(None, None, "seq", None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+
+    f = jax.jit(lambda q, k, v: sequence_parallel_attention(
+        q, k, v, mesh, impl="ring", causal=True))
+    out = f(qs, ks, vs)
+    ref = naive(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
