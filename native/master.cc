@@ -1,0 +1,310 @@
+// master.cc — fault-tolerant dataset task dispatcher (control plane).
+//
+// Native equivalent of the reference's Go master service (reference:
+// go/master/service.go:89 — todo/pending/done/failed task queues, timeout
+// requeue :313-355, failure cap, etcd-backed snapshot/recover :166-230,
+// save-model election :481). Redesigned for the TPU stack: the state
+// machine lives in C++ behind a C ABI; Python wraps it with ctypes and
+// serves it over TCP (paddle_tpu/distributed/master.py), with snapshots
+// persisted to a file path (shared-fs replacement for etcd).
+//
+// Concurrency: one mutex per master handle; all calls are thread-safe.
+// Task payloads are opaque byte strings (typically recordio shard paths).
+//
+// C ABI only (consumed from Python via ctypes).
+
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Task {
+  std::string payload;
+  int32_t epoch = 0;        // bumped on every dispatch; stale acks rejected
+  int32_t num_failure = 0;
+  double deadline = 0.0;    // valid while pending
+};
+
+enum class Where { kTodo, kPending, kDone, kFailed };
+
+struct Master {
+  std::mutex mu;
+  double timeout_s;
+  int32_t failure_max;
+  std::vector<Task> tasks;
+  std::vector<Where> where;
+  std::deque<int64_t> todo;
+  std::map<int64_t, double> pending;  // task id -> deadline
+  int64_t done_count = 0;
+  int64_t failed_count = 0;
+  double last_save = -1e300;
+};
+
+void put_u32(std::string* out, uint32_t v) {
+  char b[4] = {char(v & 0xff), char((v >> 8) & 0xff), char((v >> 16) & 0xff),
+               char((v >> 24) & 0xff)};
+  out->append(b, 4);
+}
+void put_u64(std::string* out, uint64_t v) {
+  put_u32(out, uint32_t(v & 0xffffffffu));
+  put_u32(out, uint32_t(v >> 32));
+}
+void put_f64(std::string* out, double v) {
+  uint64_t u;
+  std::memcpy(&u, &v, 8);
+  put_u64(out, u);
+}
+uint32_t get_u32(const uint8_t* p) {
+  return uint32_t(p[0]) | (uint32_t(p[1]) << 8) | (uint32_t(p[2]) << 16) |
+         (uint32_t(p[3]) << 24);
+}
+uint64_t get_u64(const uint8_t* p) {
+  return uint64_t(get_u32(p)) | (uint64_t(get_u32(p + 4)) << 32);
+}
+double get_f64(const uint8_t* p) {
+  uint64_t u = get_u64(p);
+  double v;
+  std::memcpy(&v, &u, 8);
+  return v;
+}
+
+constexpr uint32_t kSnapMagic = 0x4D535430;  // "MST0"
+
+// Requeue or fail a task that timed out / was reported failed
+// (reference: go/master/service.go processFailedTask :313).
+void fail_task_locked(Master* m, int64_t id) {
+  // no epoch bump here: every dispatch bumps it, which already makes the
+  // timed-out owner's ack stale once the task is re-dispatched
+  Task& t = m->tasks[size_t(id)];
+  t.num_failure++;
+  m->pending.erase(id);
+  if (t.num_failure > m->failure_max) {
+    m->where[size_t(id)] = Where::kFailed;
+    m->failed_count++;
+  } else {
+    m->where[size_t(id)] = Where::kTodo;
+    m->todo.push_back(id);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ms_create(double timeout_s, int failure_max) {
+  auto* m = new Master();
+  m->timeout_s = timeout_s;
+  m->failure_max = failure_max;
+  return m;
+}
+
+void ms_destroy(void* h) { delete static_cast<Master*>(h); }
+
+// Replaces any existing dataset (reference: SetDataset, service.go:280).
+int ms_set_dataset(void* h, const char** datas, const uint64_t* lens,
+                   int n) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  m->tasks.clear();
+  m->where.clear();
+  m->todo.clear();
+  m->pending.clear();
+  m->done_count = 0;
+  m->failed_count = 0;
+  m->tasks.reserve(size_t(n));
+  for (int i = 0; i < n; i++) {
+    Task t;
+    t.payload.assign(datas[i], size_t(lens[i]));
+    m->tasks.push_back(std::move(t));
+    m->where.push_back(Where::kTodo);
+    m->todo.push_back(i);
+  }
+  return 0;
+}
+
+// Pop a task. Returns a malloc'd copy of the payload (caller frees with
+// ms_free; a borrowed pointer would race with a concurrent set_dataset
+// freeing the backing string) or NULL. status: 0 = dispatched, 1 = no
+// todo tasks but pending outstanding (caller should wait+retry), 2 =
+// pass finished (todo and pending both empty).
+char* ms_get_task(void* h, double now, int64_t* task_id,
+                  int32_t* epoch, uint64_t* len, int32_t* status) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  if (m->todo.empty()) {
+    *status = m->pending.empty() ? 2 : 1;
+    return nullptr;
+  }
+  int64_t id = m->todo.front();
+  m->todo.pop_front();
+  Task& t = m->tasks[size_t(id)];
+  t.epoch++;
+  t.deadline = now + m->timeout_s;
+  m->where[size_t(id)] = Where::kPending;
+  m->pending[id] = t.deadline;
+  *task_id = id;
+  *epoch = t.epoch;
+  *len = t.payload.size();
+  *status = 0;
+  char* out = static_cast<char*>(std::malloc(t.payload.size() + 1));
+  std::memcpy(out, t.payload.data(), t.payload.size());
+  out[t.payload.size()] = 0;
+  return out;
+}
+
+// 0 ok; -1 unknown/stale (not pending or epoch mismatch) — mirrors the
+// Go master discarding acks from timed-out owners (service.go:380-420).
+int ms_task_finished(void* h, int64_t id, int32_t epoch) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  if (id < 0 || size_t(id) >= m->tasks.size()) return -1;
+  if (m->where[size_t(id)] != Where::kPending) return -1;
+  if (m->tasks[size_t(id)].epoch != epoch) return -1;
+  m->pending.erase(id);
+  m->where[size_t(id)] = Where::kDone;
+  m->tasks[size_t(id)].num_failure = 0;
+  m->done_count++;
+  return 0;
+}
+
+int ms_task_failed(void* h, int64_t id, int32_t epoch) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  if (id < 0 || size_t(id) >= m->tasks.size()) return -1;
+  if (m->where[size_t(id)] != Where::kPending) return -1;
+  if (m->tasks[size_t(id)].epoch != epoch) return -1;
+  fail_task_locked(m, id);
+  return 0;
+}
+
+// Requeue every pending task past its deadline (reference:
+// checkTimeoutFunc, service.go:341-355). Returns the number requeued.
+int ms_tick(void* h, double now) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  std::vector<int64_t> expired;
+  for (auto& kv : m->pending)
+    if (kv.second <= now) expired.push_back(kv.first);
+  for (int64_t id : expired) fail_task_locked(m, id);
+  return int(expired.size());
+}
+
+// Move done (and optionally failed) tasks back to todo for another pass.
+int ms_new_pass(void* h, int include_failed) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  int moved = 0;
+  for (size_t i = 0; i < m->tasks.size(); i++) {
+    Where w = m->where[i];
+    if (w == Where::kDone || (include_failed && w == Where::kFailed)) {
+      if (w == Where::kFailed) m->tasks[i].num_failure = 0;
+      m->where[i] = Where::kTodo;
+      m->todo.push_back(int64_t(i));
+      moved++;
+    }
+  }
+  m->done_count = 0;
+  if (include_failed) m->failed_count = 0;
+  return moved;
+}
+
+int64_t ms_count(void* h, int which) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  switch (which) {
+    case 0: return int64_t(m->todo.size());
+    case 1: return int64_t(m->pending.size());
+    case 2: return m->done_count;
+    case 3: return m->failed_count;
+    case 4: return int64_t(m->tasks.size());
+  }
+  return -1;
+}
+
+// Save-model election (reference: RequestSaveModel, service.go:481): the
+// first requester within each min_interval window wins.
+int ms_request_save(void* h, double now, double min_interval) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  if (now - m->last_save < min_interval) return 0;
+  m->last_save = now;
+  return 1;
+}
+
+// Full-state snapshot (reference: etcd snapshot/recover, service.go
+// :166-230). Caller frees with ms_free.
+char* ms_snapshot(void* h, uint64_t* out_len) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  std::string buf;
+  put_u32(&buf, kSnapMagic);
+  put_f64(&buf, m->timeout_s);
+  put_u32(&buf, uint32_t(m->failure_max));
+  put_f64(&buf, m->last_save);
+  put_u64(&buf, m->tasks.size());
+  for (size_t i = 0; i < m->tasks.size(); i++) {
+    const Task& t = m->tasks[i];
+    put_u64(&buf, t.payload.size());
+    buf.append(t.payload);
+    put_u32(&buf, uint32_t(t.epoch));
+    put_u32(&buf, uint32_t(t.num_failure));
+    // pending tasks snapshot as todo: after recovery their owners are
+    // presumed dead, matching the Go master's recovery semantics.
+    Where w = m->where[i];
+    if (w == Where::kPending) w = Where::kTodo;
+    put_u32(&buf, uint32_t(w));
+  }
+  char* out = static_cast<char*>(std::malloc(buf.size()));
+  std::memcpy(out, buf.data(), buf.size());
+  *out_len = buf.size();
+  return out;
+}
+
+void ms_free(void* p) { std::free(p); }
+
+int ms_recover(void* h, const char* data, uint64_t len) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(data);
+  const uint8_t* end = p + len;
+  // fixed header: magic(4) + timeout(8) + failure_max(4) + last_save(8)
+  // + task count(8) = 32 bytes
+  if (len < 32 || get_u32(p) != kSnapMagic) return -1;
+  p += 4;
+  m->timeout_s = get_f64(p); p += 8;
+  m->failure_max = int32_t(get_u32(p)); p += 4;
+  m->last_save = get_f64(p); p += 8;
+  uint64_t n = get_u64(p); p += 8;
+  m->tasks.clear(); m->where.clear(); m->todo.clear();
+  m->pending.clear(); m->done_count = 0; m->failed_count = 0;
+  for (uint64_t i = 0; i < n; i++) {
+    if (uint64_t(end - p) < 8) return -1;
+    uint64_t plen = get_u64(p); p += 8;
+    // avoid pointer-arithmetic overflow on corrupt plen: compare against
+    // the remaining byte count
+    if (plen > uint64_t(end - p) || uint64_t(end - p) - plen < 12)
+      return -1;
+    Task t;
+    t.payload.assign(reinterpret_cast<const char*>(p), size_t(plen));
+    p += plen;
+    t.epoch = int32_t(get_u32(p)); p += 4;
+    t.num_failure = int32_t(get_u32(p)); p += 4;
+    uint32_t wraw = get_u32(p); p += 4;
+    if (wraw > uint32_t(Where::kFailed)) return -1;  // corrupt state tag
+    Where w = Where(wraw);
+    if (w == Where::kPending) w = Where::kTodo;  // owner presumed dead
+    m->tasks.push_back(std::move(t));
+    m->where.push_back(w);
+    if (w == Where::kTodo) m->todo.push_back(int64_t(i));
+    else if (w == Where::kDone) m->done_count++;
+    else if (w == Where::kFailed) m->failed_count++;
+  }
+  return 0;
+}
+
+}  // extern "C"

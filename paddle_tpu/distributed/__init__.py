@@ -1,0 +1,12 @@
+"""Distributed control plane: fault-tolerant data dispatch + elastic
+checkpointing (host-side; the compute path's distribution is XLA
+collectives over ICI/DCN — see parallel/).
+
+Replaces the reference's Go cloud layer (go/master/service.go task queues,
+go/pserver checkpointing) with a native C++ state machine
+(native/master.cc) served over TCP, and file-based snapshots standing in
+for etcd.
+"""
+from .master import Master, MasterServer, MasterClient  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    save_checkpoint, load_checkpoint, latest_checkpoint)

@@ -1,0 +1,312 @@
+"""Fault-tolerant dataset task dispatcher.
+
+Native C++ state machine (native/master.cc) wrapping the reference Go
+master's semantics (reference: go/master/service.go:89 — GetTask /
+TaskFinished / TaskFailed / SetDataset / RequestSaveModel RPCs :280-481,
+timeout requeue :341-355, failure cap :313, etcd snapshot/recover
+:166-230). Here the RPC transport is newline-delimited JSON over TCP
+(gRPC-free image), and snapshots persist to a filesystem path — the
+shared-fs stand-in for etcd. A background ticker drives timeout requeue.
+"""
+from __future__ import annotations
+
+import base64
+import ctypes
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from typing import Callable, Iterable, List, Optional, Sequence
+
+from ..native import lib
+
+
+class Master:
+    """In-process task queue (the C++ state machine)."""
+
+    #: ms_count selectors
+    TODO, PENDING, DONE, FAILED, TOTAL = range(5)
+
+    def __init__(self, timeout_s: float = 60.0, failure_max: int = 3,
+                 snapshot_path: Optional[str] = None,
+                 snapshot_interval_s: float = 1.0):
+        self._lib = lib()
+        self._h = self._lib.ms_create(float(timeout_s), int(failure_max))
+        self._lock = threading.Lock()
+        self.snapshot_path = snapshot_path
+        self.snapshot_interval_s = snapshot_interval_s
+        self._last_snapshot = 0.0
+        if snapshot_path and os.path.exists(snapshot_path):
+            with open(snapshot_path, "rb") as f:
+                data = f.read()
+            if self._lib.ms_recover(self._h, data, len(data)) != 0:
+                raise ValueError(f"corrupt master snapshot {snapshot_path}")
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._lib.ms_destroy(h)
+
+    def set_dataset(self, tasks: Sequence[bytes]):
+        tasks = [t if isinstance(t, bytes) else str(t).encode()
+                 for t in tasks]
+        n = len(tasks)
+        datas = (ctypes.c_char_p * n)(*tasks)
+        lens = (ctypes.c_uint64 * n)(*[len(t) for t in tasks])
+        self._lib.ms_set_dataset(self._h, datas, lens, n)
+        self._maybe_snapshot()
+
+    def get_task(self, now: Optional[float] = None):
+        """Returns (payload bytes, task_id, epoch) or (None, status, 0)
+        where status 1 = wait (tasks pending elsewhere), 2 = pass done."""
+        tid = ctypes.c_int64()
+        epoch = ctypes.c_int32()
+        ln = ctypes.c_uint64()
+        status = ctypes.c_int32()
+        p = self._lib.ms_get_task(
+            self._h, time.time() if now is None else now,
+            ctypes.byref(tid), ctypes.byref(epoch),
+            ctypes.byref(ln), ctypes.byref(status))
+        if not p:
+            return None, int(status.value), 0
+        try:
+            payload = ctypes.string_at(p, ln.value)
+        finally:
+            self._lib.ms_free(p)
+        return payload, int(tid.value), int(epoch.value)
+
+    def task_finished(self, task_id: int, epoch: int) -> bool:
+        ok = self._lib.ms_task_finished(self._h, task_id, epoch) == 0
+        if ok:
+            # debounced: a lost recent ack is recovered conservatively
+            # (pending -> todo), so per-ack durability is not required
+            self._maybe_snapshot(debounce=True)
+        return ok
+
+    def task_failed(self, task_id: int, epoch: int) -> bool:
+        return self._lib.ms_task_failed(self._h, task_id, epoch) == 0
+
+    def tick(self, now: Optional[float] = None) -> int:
+        return self._lib.ms_tick(
+            self._h, time.time() if now is None else now)
+
+    def new_pass(self, include_failed: bool = False) -> int:
+        return self._lib.ms_new_pass(self._h, int(include_failed))
+
+    def count(self, which: int) -> int:
+        return self._lib.ms_count(self._h, which)
+
+    def counts(self) -> dict:
+        return {"todo": self.count(0), "pending": self.count(1),
+                "done": self.count(2), "failed": self.count(3),
+                "total": self.count(4)}
+
+    def request_save_model(self, min_interval_s: float = 60.0,
+                           now: Optional[float] = None) -> bool:
+        """Election: True for exactly one caller per interval (reference:
+        go/master/service.go:481)."""
+        return self._lib.ms_request_save(
+            self._h, time.time() if now is None else now,
+            float(min_interval_s)) == 1
+
+    def snapshot(self) -> bytes:
+        ln = ctypes.c_uint64()
+        p = self._lib.ms_snapshot(self._h, ctypes.byref(ln))
+        try:
+            return ctypes.string_at(p, ln.value)
+        finally:
+            self._lib.ms_free(p)
+
+    def _maybe_snapshot(self, debounce: bool = False):
+        if not self.snapshot_path:
+            return
+        with self._lock:
+            now = time.time()
+            if debounce and now - self._last_snapshot < \
+                    self.snapshot_interval_s:
+                return
+            self._last_snapshot = now
+            data = self.snapshot()
+            tmp = self.snapshot_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, self.snapshot_path)
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        master: Master = self.server.master  # type: ignore[attr-defined]
+        for line in self.rfile:
+            try:
+                req = json.loads(line)
+                method = req.get("method")
+                if method == "get_task":
+                    payload, tid, epoch = master.get_task()
+                    if payload is None:
+                        resp = {"status": tid}  # 1 wait / 2 pass done
+                    else:
+                        resp = {"status": 0, "task_id": tid,
+                                "epoch": epoch,
+                                "payload": base64.b64encode(
+                                    payload).decode()}
+                elif method == "task_finished":
+                    resp = {"ok": master.task_finished(
+                        req["task_id"], req["epoch"])}
+                elif method == "task_failed":
+                    resp = {"ok": master.task_failed(
+                        req["task_id"], req["epoch"])}
+                elif method == "set_dataset":
+                    master.set_dataset([base64.b64decode(t)
+                                        for t in req["tasks"]])
+                    resp = {"ok": True}
+                elif method == "new_pass":
+                    resp = {"moved": master.new_pass(
+                        req.get("include_failed", False))}
+                elif method == "counts":
+                    resp = master.counts()
+                elif method == "request_save_model":
+                    resp = {"granted": master.request_save_model(
+                        req.get("min_interval_s", 60.0))}
+                else:
+                    resp = {"error": f"unknown method {method!r}"}
+            except Exception as e:  # malformed request must not kill server
+                resp = {"error": repr(e)}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class MasterServer:
+    """Threaded TCP server around a Master, with a timeout-requeue ticker
+    (the reference runs checkTimeoutFunc per task with time.After;
+    go/master/service.go:341)."""
+
+    def __init__(self, master: Master, host: str = "127.0.0.1",
+                 port: int = 0, tick_interval_s: float = 1.0):
+        self.master = master
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self._server.master = master  # type: ignore[attr-defined]
+        self.endpoint = "{}:{}".format(*self._server.server_address)
+        self._threads = [
+            threading.Thread(target=self._server.serve_forever,
+                             daemon=True),
+            threading.Thread(target=self._ticker,
+                             args=(tick_interval_s,), daemon=True),
+        ]
+        self._stop = threading.Event()
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def _ticker(self, interval):
+        while not self._stop.wait(interval):
+            self.master.tick()
+
+    def shutdown(self):
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class MasterClient:
+    """Client with reconnect + the Go client's task-loop semantics
+    (reference: go/master/client.go + python/paddle/v2/master/client.py:29)."""
+
+    def __init__(self, endpoint: str, retry_s: float = 0.2,
+                 max_retries: int = 50):
+        self.endpoint = endpoint
+        self.retry_s = retry_s
+        self.max_retries = max_retries
+        self._sock = None
+        self._file = None
+        self._lock = threading.Lock()
+
+    def _connect(self):
+        host, port = self.endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)), timeout=30)
+        self._file = self._sock.makefile("rwb")
+
+    def _call(self, req: dict) -> dict:
+        with self._lock:
+            for attempt in range(self.max_retries):
+                try:
+                    if self._file is None:
+                        self._connect()
+                    self._file.write((json.dumps(req) + "\n").encode())
+                    self._file.flush()
+                    line = self._file.readline()
+                    if not line:
+                        raise ConnectionError("server closed")
+                    return json.loads(line)
+                except (OSError, ConnectionError, json.JSONDecodeError):
+                    self._close()
+                    if attempt == self.max_retries - 1:
+                        raise
+                    time.sleep(self.retry_s)
+
+    def _close(self):
+        try:
+            if self._sock:
+                self._sock.close()
+        except OSError:
+            pass
+        self._sock = self._file = None
+
+    def get_task(self):
+        r = self._call({"method": "get_task"})
+        if r.get("status") == 0:
+            return (base64.b64decode(r["payload"]), r["task_id"],
+                    r["epoch"])
+        return None, r.get("status", 1), 0
+
+    def task_finished(self, task_id, epoch) -> bool:
+        return self._call({"method": "task_finished", "task_id": task_id,
+                           "epoch": epoch}).get("ok", False)
+
+    def task_failed(self, task_id, epoch) -> bool:
+        return self._call({"method": "task_failed", "task_id": task_id,
+                           "epoch": epoch}).get("ok", False)
+
+    def set_dataset(self, tasks: Sequence[bytes]):
+        enc = [base64.b64encode(t if isinstance(t, bytes) else
+                                str(t).encode()).decode() for t in tasks]
+        self._call({"method": "set_dataset", "tasks": enc})
+
+    def counts(self) -> dict:
+        return self._call({"method": "counts"})
+
+    def new_pass(self, include_failed=False) -> int:
+        return self._call({"method": "new_pass",
+                           "include_failed": include_failed})["moved"]
+
+    def request_save_model(self, min_interval_s: float = 60.0) -> bool:
+        return self._call({"method": "request_save_model",
+                           "min_interval_s": min_interval_s})["granted"]
+
+    def task_reader(self, read_fn: Callable[[bytes], Iterable],
+                    wait_s: float = 0.05):
+        """One training pass: pull tasks until the pass is drained,
+        yielding records via read_fn(payload); acks on completion
+        (reference trainer loop: v2/master/client.py next_record)."""
+        while True:
+            payload, tid, epoch = self.get_task()
+            if payload is None:
+                if tid == 2:      # pass finished
+                    return
+                time.sleep(wait_s)  # others still working; wait for requeue
+                continue
+            try:
+                for rec in read_fn(payload):
+                    yield rec
+            except Exception:
+                self.task_failed(tid, epoch)
+                raise
+            self.task_finished(tid, epoch)

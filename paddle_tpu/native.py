@@ -63,6 +63,42 @@ def lib() -> ctypes.CDLL:
         l.dl_error.restype = ctypes.c_char_p
         l.dl_error.argtypes = [ctypes.c_void_p]
         l.dl_close.argtypes = [ctypes.c_void_p]
+
+        # master task dispatcher (native/master.cc)
+        l.ms_create.restype = ctypes.c_void_p
+        l.ms_create.argtypes = [ctypes.c_double, ctypes.c_int]
+        l.ms_destroy.argtypes = [ctypes.c_void_p]
+        l.ms_set_dataset.restype = ctypes.c_int
+        l.ms_set_dataset.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+        l.ms_get_task.restype = ctypes.POINTER(ctypes.c_char)  # malloc-copy; free via ms_free
+        l.ms_get_task.argtypes = [
+            ctypes.c_void_p, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int32)]
+        l.ms_task_finished.restype = ctypes.c_int
+        l.ms_task_finished.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                       ctypes.c_int32]
+        l.ms_task_failed.restype = ctypes.c_int
+        l.ms_task_failed.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                     ctypes.c_int32]
+        l.ms_tick.restype = ctypes.c_int
+        l.ms_tick.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        l.ms_new_pass.restype = ctypes.c_int
+        l.ms_new_pass.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        l.ms_count.restype = ctypes.c_int64
+        l.ms_count.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        l.ms_request_save.restype = ctypes.c_int
+        l.ms_request_save.argtypes = [ctypes.c_void_p, ctypes.c_double,
+                                      ctypes.c_double]
+        l.ms_snapshot.restype = ctypes.POINTER(ctypes.c_char)
+        l.ms_snapshot.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_uint64)]
+        l.ms_free.argtypes = [ctypes.c_void_p]
+        l.ms_recover.restype = ctypes.c_int
+        l.ms_recover.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_uint64]
         _lib = l
     return _lib
 
