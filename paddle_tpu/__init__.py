@@ -28,5 +28,6 @@ from . import profiler  # noqa
 from .parallel import ParallelExecutor  # noqa
 from . import reader  # noqa
 from .reader import batch  # noqa
+from . import concurrency  # noqa
 
 __version__ = "0.1.0"

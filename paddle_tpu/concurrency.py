@@ -1,0 +1,234 @@
+"""CSP concurrency: Go-style channels, go(), select.
+
+Capability parity with the reference's in-program CSP (reference:
+paddle/fluid/framework/channel.h:33-207 + channel_impl.h semantics,
+go_op.cc:29, select_op.cc, python/paddle/fluid/concurrency.py). Design
+delta, on purpose: the reference executes channel ops inside the program
+interpreter on executor threads; under XLA everything in-graph is traced
+and compiled, so blocking rendezvous cannot live there. The TPU-native
+equivalent is host-side: channels coordinate the Python/runtime layer
+(reader pipelines, checkpoint writers, the master client), while in-graph
+"concurrency" is XLA's own async scheduling. Semantics preserved from
+channel_impl.h:
+  - capacity 0 => unbuffered rendezvous (send blocks for a receiver)
+  - send on a closed channel raises ChannelClosed (EnforceNotMet there)
+  - recv on closed: drains remaining buffered items, then returns
+    (None, False)
+  - close is idempotent; waiters wake immediately
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+__all__ = ["Channel", "ChannelClosed", "go", "select", "make_channel",
+           "channel_send", "channel_recv", "channel_close"]
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class Channel:
+    """Buffered (capacity > 0) or unbuffered rendezvous channel."""
+
+    def __init__(self, capacity: int = 0, dtype=None, name: str = ""):
+        self.capacity = int(capacity)
+        self.dtype = dtype          # advisory, like the reference's VarType
+        self.name = name
+        self._mu = threading.Lock()
+        self._not_full = threading.Condition(self._mu)
+        self._not_empty = threading.Condition(self._mu)
+        self._buf: deque = deque()
+        self._closed = False
+        # unbuffered: number of receivers ready to take a handoff
+        self._recv_waiting = 0
+        self._handoff: deque = deque()
+
+    # -- core ops ---------------------------------------------------------
+    def send(self, value: Any, timeout: Optional[float] = None) -> bool:
+        """Blocks until delivered. Raises ChannelClosed if the channel is
+        (or becomes) closed before delivery. Returns True on delivery,
+        False on timeout."""
+        with self._mu:
+            if self._closed:
+                raise ChannelClosed(f"send on closed channel {self.name!r}")
+            if self.capacity > 0:
+                deadline = _deadline(timeout)
+                while len(self._buf) >= self.capacity:
+                    if not _wait(self._not_full, deadline):
+                        return False
+                    if self._closed:
+                        raise ChannelClosed(
+                            f"send on closed channel {self.name!r}")
+                self._buf.append(value)
+                self._not_empty.notify()
+                return True
+            # unbuffered: rendezvous with a receiver. The value travels in
+            # an identity cell so removal never compares values (arrays
+            # don't support ==-in-deque membership).
+            cell = [value]
+            self._handoff.append(cell)
+            self._not_empty.notify()
+            deadline = _deadline(timeout)
+            while any(c is cell for c in self._handoff):
+                if self._closed:
+                    try:
+                        self._handoff.remove(cell)
+                        raise ChannelClosed(
+                            f"send on closed channel {self.name!r}")
+                    except ValueError:
+                        return True  # taken concurrently with close
+                if not _wait(self._not_full, deadline):
+                    try:
+                        self._handoff.remove(cell)
+                        return False
+                    except ValueError:
+                        return True  # taken right at the deadline
+            return True
+
+    def recv(self, timeout: Optional[float] = None) -> Tuple[Any, bool]:
+        """Returns (value, True), or (None, False) once closed and
+        drained (or on timeout)."""
+        with self._mu:
+            deadline = _deadline(timeout)
+            while True:
+                if self._buf:
+                    v = self._buf.popleft()
+                    self._not_full.notify()
+                    return v, True
+                if self._handoff:
+                    cell = self._handoff.popleft()
+                    self._not_full.notify_all()
+                    return cell[0], True
+                if self._closed:
+                    return None, False
+                self._recv_waiting += 1
+                try:
+                    woke = _wait(self._not_empty, deadline)
+                finally:
+                    self._recv_waiting -= 1
+                if not woke:
+                    return None, False
+
+    def close(self):
+        with self._mu:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def closed(self) -> bool:
+        with self._mu:
+            return self._closed
+
+    def __len__(self):
+        with self._mu:
+            return len(self._buf) + len(self._handoff)
+
+    def can_recv_now(self) -> bool:
+        with self._mu:
+            return bool(self._buf or self._handoff or self._closed)
+
+    def can_send_now(self) -> bool:
+        with self._mu:
+            if self._closed:
+                return False
+            if self.capacity > 0:
+                return len(self._buf) < self.capacity
+            return self._recv_waiting > 0
+
+    def __iter__(self):
+        while True:
+            v, ok = self.recv()
+            if not ok:
+                return
+            yield v
+
+
+def _deadline(timeout):
+    return None if timeout is None else _now() + timeout
+
+
+def _now():
+    import time
+    return time.monotonic()
+
+
+def _wait(cond: threading.Condition, deadline) -> bool:
+    if deadline is None:
+        cond.wait()
+        return True
+    remaining = deadline - _now()
+    if remaining <= 0:
+        return False
+    return cond.wait(remaining)
+
+
+def go(fn: Callable, *args, **kwargs) -> threading.Thread:
+    """Spawn fn concurrently (reference: go_op.cc:29 runs a sub-block on a
+    detached executor thread)."""
+    t = threading.Thread(target=fn, args=args, kwargs=kwargs, daemon=True)
+    t.start()
+    return t
+
+
+def select(cases: Sequence[Tuple[str, Channel, Any]],
+           default: Optional[Callable] = None,
+           poll_interval: float = 0.001):
+    """Multi-way select (reference: select_op.cc). cases is a list of
+    ("recv", ch, callback(value, ok)) / ("send", ch, (value, callback)).
+    Blocks until one case fires unless `default` is given. Returns the
+    index of the fired case (-1 for default)."""
+    import random
+    import time
+    while True:
+        order = list(range(len(cases)))
+        random.shuffle(order)      # fairness, like Go's select
+        for i in order:
+            kind, ch, arg = cases[i]
+            if kind == "recv":
+                if ch.can_recv_now():
+                    v, ok = ch.recv(timeout=0)
+                    # a racing receiver may have taken it; (None, False)
+                    # on an open channel means retry
+                    if ok or ch.closed:
+                        if arg is not None:
+                            arg(v, ok)
+                        return i
+            elif kind == "send":
+                value, cb = arg
+                # attempt unconditionally: an unbuffered send must enqueue
+                # its handoff cell for a polling select-recv peer to see
+                # (gating on a blocked receiver would livelock two selects).
+                # ChannelClosed propagates — Go's select panics on
+                # send-to-closed, and hanging silently would be worse.
+                if ch.send(value, timeout=poll_interval * 10):
+                    if cb is not None:
+                        cb()
+                    return i
+            else:
+                raise ValueError(f"unknown select case kind {kind!r}")
+        if default is not None:
+            default()
+            return -1
+        time.sleep(poll_interval)
+
+
+# fluid.concurrency-style aliases (reference: concurrency.py:451)
+def make_channel(dtype=None, capacity: int = 0) -> Channel:
+    return Channel(capacity=capacity, dtype=dtype)
+
+
+def channel_send(ch: Channel, value) -> bool:
+    return ch.send(value)
+
+
+def channel_recv(ch: Channel):
+    return ch.recv()
+
+
+def channel_close(ch: Channel):
+    ch.close()

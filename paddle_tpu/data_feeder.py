@@ -1,0 +1,71 @@
+"""DataFeeder: reader tuples -> feed dict (reference:
+python/paddle/fluid/data_feeder.py DataFeeder.feed — converts a batch of
+per-sample tuples to LoDTensors per data var; v2's `feeding` dict).
+
+TPU-native: dense vars become stacked numpy arrays; lod_level>0 vars
+become RaggedPair (padded data + lengths), the framework's static-shape
+LoD representation. Padding length defaults to the longest sequence in
+the batch, bucketed up to `pad_multiple` to limit XLA recompilation."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .core.lod import LoDTensor, RaggedPair
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence, place=None,
+                 pad_multiple: int = 16,
+                 max_lens: Optional[Dict[str, int]] = None):
+        self.feed_vars = list(feed_list)
+        self.pad_multiple = pad_multiple
+        self.max_lens = max_lens or {}
+
+    def feed(self, batch: Sequence[Sequence]) -> Dict[str, object]:
+        """batch: iterable of per-sample tuples aligned with feed_list."""
+        out: Dict[str, object] = {}
+        for i, var in enumerate(self.feed_vars):
+            name = var if isinstance(var, str) else var.name
+            lod_level = 0 if isinstance(var, str) else (var.lod_level or 0)
+            dtype = "float32" if isinstance(var, str) else var.dtype
+            column = [sample[i] for sample in batch]
+            if lod_level > 0:
+                out[name] = self._ragged(name, column, dtype, var)
+            else:
+                arr = np.asarray(column, dtype=np.dtype(dtype))
+                shape = None if isinstance(var, str) else var.shape
+                if shape is not None and len(shape) >= 1 and arr.ndim == 1:
+                    arr = arr.reshape(len(column), *[
+                        d for d in shape[1:] if d and d > 0] or [1])
+                out[name] = arr
+        return out
+
+    def _ragged(self, name, column, dtype, var):
+        np_dtype = np.dtype(dtype)
+        feat = None
+        if not isinstance(var, str) and var.shape:
+            # declared [-1?, feat...]: per-step feature dims after batch
+            feat = [d for d in var.shape[1:] if d and d > 0]
+        arrs = []
+        for seq in column:
+            a = np.asarray(seq, np_dtype)
+            if feat and a.ndim == 1:
+                a = a.reshape(len(a) // int(np.prod(feat)), *feat) \
+                    if np.prod(feat) > 1 else a.reshape(len(a), *feat)
+            elif a.ndim == 1:
+                a = a.reshape(len(a), 1)
+            arrs.append(a)
+        max_len = self.max_lens.get(name)
+        if max_len is None:
+            longest = max((a.shape[0] for a in arrs), default=1)
+            m = self.pad_multiple
+            max_len = ((longest + m - 1) // m) * m
+        else:
+            # a hard cap truncates (the standard bucketing behavior);
+            # to_padded would otherwise fail on longer sequences
+            arrs = [a[:max_len] for a in arrs]
+        lod = LoDTensor.from_sequences(arrs)
+        padded, lengths = lod.to_padded(max_len=max_len)
+        return RaggedPair(padded, lengths)

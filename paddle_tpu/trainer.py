@@ -1,0 +1,180 @@
+"""Event-loop Trainer (reference: python/paddle/v2/trainer.py:37 — the
+SGD class whose train() pumps a reader through forward/backward and fires
+BeginPass/EndPass/BeginIteration/EndIteration events, v2/event.py; the
+same loop fluid scripts hand-write around exe.run).
+
+TPU-native: one Executor (or ParallelExecutor over a mesh) runs the
+jit-compiled step; the event loop, metrics plumbing, periodic elastic
+checkpointing (distributed/checkpoint.py), and test() evaluation live
+here on the host."""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+
+class BeginPass:
+    def __init__(self, pass_id):
+        self.pass_id = pass_id
+
+
+class EndPass:
+    def __init__(self, pass_id, metrics=None):
+        self.pass_id = pass_id
+        self.metrics = metrics or {}
+
+
+class BeginIteration:
+    def __init__(self, pass_id, batch_id):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndIteration:
+    def __init__(self, pass_id, batch_id, cost, metrics=None):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost
+        self.metrics = metrics or {}
+
+
+class CheckpointConfig:
+    def __init__(self, dirname: str, every_n_batches: int = 100,
+                 max_keep: int = 3):
+        self.dirname = dirname
+        self.every_n_batches = every_n_batches
+        self.max_keep = max_keep
+
+
+class Trainer:
+    """train() pumps reader batches through the program; each yielded
+    batch is either a feed dict or a tuple routed through a DataFeeder
+    built from `feed_order`."""
+
+    def __init__(self, loss, main_program=None, startup_program=None,
+                 executor=None, feed_order: Optional[Sequence] = None,
+                 fetch_metrics: Optional[Dict[str, object]] = None,
+                 checkpoint_config: Optional[CheckpointConfig] = None,
+                 feeder_kwargs: Optional[dict] = None):
+        from .framework import (default_main_program,
+                                default_startup_program)
+        from .executor import Executor
+
+        self.loss = loss
+        self.main_program = main_program or default_main_program()
+        self.startup_program = startup_program or \
+            default_startup_program()
+        self.exe = executor or Executor()
+        self.fetch_metrics = dict(fetch_metrics or {})
+        self.checkpoint_config = checkpoint_config
+        self._feeder = None
+        if feed_order:
+            from .data_feeder import DataFeeder
+            vars_ = [self.main_program.global_block().var(n)
+                     if isinstance(n, str) else n for n in feed_order]
+            self._feeder = DataFeeder(vars_, **(feeder_kwargs or {}))
+        self._started = False
+        self.step = 0
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self, resume: bool = True):
+        """Run startup (param init), then restore the newest valid
+        checkpoint if configured (elastic resume)."""
+        self.exe.run(self.startup_program)
+        if resume and self.checkpoint_config:
+            from .distributed.checkpoint import load_checkpoint
+            meta = load_checkpoint(self.checkpoint_config.dirname,
+                                   main_program=self.main_program,
+                                   executor=self.exe)
+            if meta:
+                self.step = int(meta.get("step", 0))
+        self._started = True
+        return self
+
+    def _to_feed(self, batch):
+        if isinstance(batch, dict):
+            return batch
+        if self._feeder is None:
+            raise ValueError(
+                "reader yielded a tuple batch but no feed_order was given")
+        return self._feeder.feed(batch)
+
+    # -- training loop ----------------------------------------------------
+    def train(self, num_passes: int, reader: Callable[[], Iterable],
+              event_handler: Optional[Callable] = None):
+        if not self._started:
+            self.start()
+        handler = event_handler or (lambda e: None)
+        fetch_names = list(self.fetch_metrics)
+        fetch_list = [self.loss] + [self.fetch_metrics[k]
+                                    for k in fetch_names]
+        for pass_id in range(num_passes):
+            handler(BeginPass(pass_id))
+            costs = []
+            for batch_id, batch in enumerate(reader()):
+                handler(BeginIteration(pass_id, batch_id))
+                feed = self._to_feed(batch)
+                outs = self.exe.run(self.main_program, feed=feed,
+                                    fetch_list=fetch_list)
+                cost = float(np.asarray(_dense(outs[0])).reshape(-1)[0])
+                metrics = {k: _dense(v) for k, v in
+                           zip(fetch_names, outs[1:])}
+                costs.append(cost)
+                self.step += 1
+                handler(EndIteration(pass_id, batch_id, cost, metrics))
+                self._maybe_checkpoint()
+            handler(EndPass(pass_id, {
+                "mean_cost": float(np.mean(costs)) if costs else None}))
+
+    def _maybe_checkpoint(self):
+        cc = self.checkpoint_config
+        if cc and self.step % cc.every_n_batches == 0:
+            from .distributed.checkpoint import save_checkpoint
+            save_checkpoint(cc.dirname, step=self.step,
+                            main_program=self.main_program,
+                            executor=self.exe, max_keep=cc.max_keep)
+
+    # -- evaluation -------------------------------------------------------
+    def test(self, reader: Callable[[], Iterable],
+             fetch_list: Optional[List] = None) -> Dict[str, float]:
+        """Mean of loss (+ metrics) over a test reader — no optimizer ops
+        run because the fetches are computed on an inference-pruned clone
+        (reference: v2 SGD.test, trainer.py:209)."""
+        from .core.executor import STEP_VAR
+        from .core.scope import global_scope
+        from .io import _prune
+
+        fetch_list = fetch_list or [self.loss]
+        names = [getattr(v, "name", v) for v in fetch_list]
+        pruned = _prune(self.main_program, [], names)
+        totals = {n: [] for n in names}
+        scope = global_scope()
+        step_before = scope.find(STEP_VAR)
+        try:
+            for batch in reader():
+                feed = self._to_feed(batch)
+                outs = self.exe.run(pruned, feed=feed, fetch_list=names)
+                for n, v in zip(names, outs):
+                    totals[n].append(
+                        np.asarray(_dense(v), np.float64).mean())
+        finally:
+            # evaluation must not advance the LR-schedule step counter
+            if step_before is not None:
+                scope.set(STEP_VAR, step_before)
+        return {n: float(np.mean(vs)) if vs else float("nan")
+                for n, vs in totals.items()}
+
+    def save_params(self, dirname: str):
+        from . import io as pt_io
+        pt_io.save_params(self.exe, dirname, self.main_program)
+
+    def save_inference_model(self, dirname: str, feed_names, targets):
+        from . import io as pt_io
+        pt_io.save_inference_model(dirname, feed_names, targets, self.exe,
+                                   main_program=self.main_program)
+
+
+def _dense(v):
+    return v.data if hasattr(v, "data") else v

@@ -1,0 +1,168 @@
+"""CSP channels/go/select (mirrors reference framework/channel_test.cc
+behaviors: buffered/unbuffered send-recv, close semantics, concurrent
+producers/consumers, select)."""
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.concurrency import (
+    Channel, ChannelClosed, channel_close, channel_recv, channel_send,
+    go, make_channel, select)
+
+
+def test_buffered_send_recv_fifo():
+    ch = make_channel(capacity=4)
+    for i in range(4):
+        assert channel_send(ch, i)
+    assert [channel_recv(ch)[0] for _ in range(4)] == [0, 1, 2, 3]
+
+
+def test_buffered_send_blocks_when_full():
+    ch = Channel(capacity=1)
+    ch.send("a")
+    assert not ch.send("b", timeout=0.05)   # full -> timeout
+    assert ch.recv() == ("a", True)
+    assert ch.send("b", timeout=0.05)
+
+
+def test_unbuffered_rendezvous():
+    ch = Channel(capacity=0)
+    got = []
+
+    def receiver():
+        got.append(ch.recv())
+
+    t = go(receiver)
+    assert ch.send(42)                      # blocks until receiver takes it
+    t.join(timeout=5)
+    assert got == [(42, True)]
+
+
+def test_unbuffered_send_times_out_without_receiver():
+    ch = Channel(capacity=0)
+    assert not ch.send(1, timeout=0.05)
+    assert len(ch) == 0                     # abandoned cell removed
+
+
+def test_send_on_closed_raises():
+    ch = Channel(capacity=2)
+    ch.close()
+    with pytest.raises(ChannelClosed):
+        ch.send(1)
+
+
+def test_close_wakes_blocked_sender():
+    ch = Channel(capacity=1)
+    ch.send(1)
+    errs = []
+
+    def sender():
+        try:
+            ch.send(2)
+        except ChannelClosed as e:
+            errs.append(e)
+
+    t = go(sender)
+    time.sleep(0.05)
+    ch.close()
+    t.join(timeout=5)
+    assert len(errs) == 1
+
+
+def test_recv_on_closed_drains_then_false():
+    ch = Channel(capacity=3)
+    ch.send(1)
+    ch.send(2)
+    channel_close(ch)
+    assert ch.recv() == (1, True)           # buffered items still drain
+    assert ch.recv() == (2, True)
+    assert ch.recv() == (None, False)
+    assert ch.recv() == (None, False)       # idempotent
+
+
+def test_concurrent_producers_consumers():
+    ch = Channel(capacity=8)
+    N, P, C = 200, 4, 4
+    out, lock = [], threading.Lock()
+
+    def producer(base):
+        for i in range(N):
+            ch.send(base * N + i)
+
+    def consumer():
+        for v in ch:
+            with lock:
+                out.append(v)
+
+    cs = [go(consumer) for _ in range(C)]
+    ps = [go(producer, p) for p in range(P)]
+    for t in ps:
+        t.join(timeout=30)
+    ch.close()
+    for t in cs:
+        t.join(timeout=30)
+    assert sorted(out) == sorted(p * N + i for p in range(P)
+                                 for i in range(N))
+
+
+def test_select_recv_and_default():
+    a, b = Channel(capacity=1), Channel(capacity=1)
+    b.send("hello")
+    fired = []
+    idx = select([("recv", a, lambda v, ok: fired.append((0, v))),
+                  ("recv", b, lambda v, ok: fired.append((1, v)))])
+    assert idx == 1 and fired == [(1, "hello")]
+    # nothing ready -> default
+    hit = []
+    idx = select([("recv", a, None)], default=lambda: hit.append(True))
+    assert idx == -1 and hit == [True]
+
+
+def test_select_send_case():
+    ch = Channel(capacity=1)
+    idx = select([("send", ch, (7, None))])
+    assert idx == 0
+    assert ch.recv() == (7, True)
+
+
+def test_go_channel_pipeline():
+    """The csp.md design doc's canonical pattern: goroutine pipeline."""
+    nums = Channel(capacity=0)
+    squares = Channel(capacity=0)
+
+    def gen():
+        for i in range(10):
+            nums.send(i)
+        nums.close()
+
+    def sq():
+        for v in nums:
+            squares.send(v * v)
+        squares.close()
+
+    go(gen)
+    go(sq)
+    assert list(squares) == [i * i for i in range(10)]
+
+
+def test_select_send_meets_select_recv_unbuffered():
+    """Two selects must complete an unbuffered rendezvous (regression:
+    gating send on a blocked receiver livelocked this pairing)."""
+    ch = Channel(capacity=0)
+    got = []
+
+    def receiver():
+        select([("recv", ch, lambda v, ok: got.append(v))])
+
+    t = go(receiver)
+    idx = select([("send", ch, (99, None))])
+    t.join(timeout=5)
+    assert idx == 0 and got == [99]
+
+
+def test_select_send_on_closed_raises():
+    ch = Channel(capacity=1)
+    ch.close()
+    with pytest.raises(ChannelClosed):
+        select([("send", ch, (1, None))])

@@ -1,0 +1,154 @@
+"""Event-loop Trainer + DataFeeder (reference: v2 SGD.train event loop,
+v2/event.py, fluid data_feeder.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu.trainer import (BeginIteration, BeginPass, CheckpointConfig,
+                                EndIteration, EndPass, Trainer)
+
+
+def _build_regression():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, size=1, bias_attr=False)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
+    return main, startup, loss, pred
+
+
+def _reader(n_batches=8, bs=16, seed=0):
+    rng = np.random.RandomState(seed)
+    W = rng.randn(8, 1).astype(np.float32)
+
+    def read():
+        r = np.random.RandomState(seed + 1)
+        for _ in range(n_batches):
+            x = r.randn(bs, 8).astype(np.float32)
+            yield {"x": x, "y": x @ W}
+    return read
+
+
+def test_trainer_events_and_convergence():
+    main, startup, loss, _ = _build_regression()
+    events = []
+    t = Trainer(loss, main_program=main, startup_program=startup)
+    t.train(num_passes=3, reader=_reader(),
+            event_handler=lambda e: events.append(e))
+    kinds = [type(e).__name__ for e in events]
+    assert kinds.count("BeginPass") == 3 and kinds.count("EndPass") == 3
+    assert kinds.count("EndIteration") == 24
+    end_passes = [e for e in events if isinstance(e, EndPass)]
+    assert end_passes[-1].metrics["mean_cost"] < \
+        end_passes[0].metrics["mean_cost"] * 0.5
+    first = next(e for e in events if isinstance(e, EndIteration))
+    assert isinstance(first.cost, float)
+
+
+def test_trainer_test_does_not_update_params():
+    main, startup, loss, pred = _build_regression()
+    t = Trainer(loss, main_program=main, startup_program=startup)
+    t.start()
+    scope = pt.global_scope()
+    pname = main.all_parameters()[0].name
+    before = np.asarray(scope.get(pname)).copy()
+    res = t.test(_reader(n_batches=3))
+    after = np.asarray(scope.get(pname))
+    np.testing.assert_array_equal(before, after)
+    assert np.isfinite(res[loss.name])
+
+
+def test_trainer_checkpoint_resume(tmp_path):
+    main, startup, loss, _ = _build_regression()
+    d = str(tmp_path / "ck")
+    t = Trainer(loss, main_program=main, startup_program=startup,
+                checkpoint_config=CheckpointConfig(d, every_n_batches=4))
+    t.train(num_passes=2, reader=_reader())
+    assert t.step == 16
+    saved = sorted(x for x in os.listdir(d) if x.startswith("checkpoint_"))
+    assert saved
+
+    # fresh scope; resume restores step and params
+    pt.reset_global_scope()
+    t2 = Trainer(loss, main_program=main, startup_program=startup,
+                 checkpoint_config=CheckpointConfig(d, every_n_batches=4))
+    t2.start(resume=True)
+    assert t2.step == 16
+
+
+def test_data_feeder_dense_and_ragged():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        words = layers.data("words", [1], dtype="int64", lod_level=1)
+        label = layers.data("label", [1], dtype="int64")
+    feeder = DataFeeder([words, label], pad_multiple=8)
+    batch = [([1, 2, 3], 0), ([4, 5], 1), ([6, 7, 8, 9, 10], 0)]
+    feed = feeder.feed(batch)
+    from paddle_tpu.core.lod import RaggedPair
+    w = feed["words"]
+    assert isinstance(w, RaggedPair)
+    assert w.data.shape == (3, 8, 1)          # padded to multiple of 8
+    np.testing.assert_array_equal(np.asarray(w.lengths), [3, 2, 5])
+    np.testing.assert_array_equal(np.asarray(w.data[0, :3, 0]), [1, 2, 3])
+    assert feed["label"].shape == (3, 1)
+
+
+def test_trainer_with_feed_order_tuples():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        words = layers.data("words", [1], dtype="int64", lod_level=1)
+        label = layers.data("label", [1], dtype="int64")
+        emb = layers.embedding(words, size=[50, 8])
+        pooled = layers.sequence_pool(emb, pool_type="sum")
+        logits = layers.fc(pooled, size=2)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        pt.optimizer.AdamOptimizer(learning_rate=5e-2).minimize(loss)
+
+    rng = np.random.RandomState(0)
+
+    def read():
+        for _ in range(6):
+            batch = []
+            for _ in range(8):
+                n = rng.randint(2, 9)
+                seq = rng.randint(1, 50, n)
+                batch.append((seq.tolist(), [int(seq.sum() % 2)]))
+            yield batch
+
+    costs = []
+    t = Trainer(loss, main_program=main, startup_program=startup,
+                feed_order=["words", "label"],
+                feeder_kwargs={"pad_multiple": 16})
+    t.train(num_passes=2, reader=read,
+            event_handler=lambda e: costs.append(e.cost)
+            if isinstance(e, EndIteration) else None)
+    assert np.isfinite(costs).all()
+
+
+def test_data_feeder_max_lens_truncates():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        words = layers.data("words", [1], dtype="int64", lod_level=1)
+    feeder = DataFeeder([words], max_lens={"words": 4})
+    feed = feeder.feed([(list(range(10)),), ([1, 2],)])
+    w = feed["words"]
+    assert w.data.shape == (2, 4, 1)
+    np.testing.assert_array_equal(np.asarray(w.lengths), [4, 2])
+
+
+def test_trainer_test_preserves_step_counter():
+    from paddle_tpu.core.executor import STEP_VAR
+    main, startup, loss, _ = _build_regression()
+    t = Trainer(loss, main_program=main, startup_program=startup)
+    t.train(num_passes=1, reader=_reader(n_batches=4))
+    scope = pt.global_scope()
+    step_before = int(np.asarray(scope.find(STEP_VAR)))
+    t.test(_reader(n_batches=5))
+    assert int(np.asarray(scope.find(STEP_VAR))) == step_before
