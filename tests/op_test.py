@@ -1,0 +1,224 @@
+"""Single-op test harness: NumPy-oracle output checks + numeric gradient
+checks (reference: python/paddle/fluid/tests/unittests/op_test.py —
+check_output :290 runs one op in a scope and compares to the test's NumPy
+reference; check_grad :378 compares the registered grad path against
+central finite differences, get_numeric_gradient :97).
+
+TPU-native twist: the op runs through the full trace->XLA pipeline (there
+is no per-op interpreter), so these checks also cover lowering. Gradients
+come from append_backward on a weighted-sum scalar loss; the numeric side
+re-runs the forward program with perturbed feeds."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.lod import RaggedPair
+from paddle_tpu.layer_helper import LayerHelper
+
+
+def _is_ragged(v) -> bool:
+    return isinstance(v, RaggedPair)
+
+
+def _dense(v):
+    return np.asarray(v.data if hasattr(v, "data") else v)
+
+
+class OpTestHarness:
+    """Build a one-op program from feeds; check outputs and gradients.
+
+    inputs: {slot: (name, array)} or {slot: [(name, array), ...]};
+    arrays may be RaggedPair for lod inputs (lod_level inferred).
+    """
+
+    def __init__(self, op_type: str, inputs: Dict, attrs: Optional[Dict]
+                 = None, out_slots: Sequence[str] = ("Out",),
+                 out_dtypes: Optional[Dict[str, str]] = None):
+        self.op_type = op_type
+        self.attrs = attrs or {}
+        self.inputs = {s: (v if isinstance(v, list) else [v])
+                       for s, v in inputs.items()}
+        self.out_slots = list(out_slots)
+        self.out_dtypes = out_dtypes or {}
+        self.feed = {}
+        for entries in self.inputs.values():
+            for name, arr in entries:
+                self.feed[name] = arr
+        self._build()
+
+    def _append_op_program(self):
+        """One op + its data vars in a fresh program (shared by the
+        forward-check and gradient-check builds)."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            in_vars = {}
+            for slot, entries in self.inputs.items():
+                vs = []
+                for name, arr in entries:
+                    if _is_ragged(arr):
+                        shape = list(np.asarray(arr.data).shape)
+                        lod = 1
+                    else:
+                        shape = list(np.asarray(arr).shape)
+                        lod = 0
+                    v = layers.data(
+                        name, shape,
+                        dtype=str(np.asarray(_dense(arr)).dtype),
+                        lod_level=lod, append_batch_size=False,
+                        stop_gradient=False)
+                    vs.append(v)
+                in_vars[slot] = vs
+            helper = LayerHelper(self.op_type)
+            out_vars = {}
+            for slot in self.out_slots:
+                dtype = self.out_dtypes.get(slot, "float32")
+                out_vars[slot] = helper.create_tmp_variable(dtype)
+            helper.append_op(
+                type=self.op_type,
+                inputs={s: v for s, v in in_vars.items()},
+                outputs={s: [v] for s, v in out_vars.items()},
+                attrs=self.attrs)
+        return main, startup, out_vars
+
+    def _build(self):
+        pt.reset_default_programs()
+        self.main, self.startup, self.out_vars = self._append_op_program()
+        self.exe = pt.Executor()
+        self.exe.run(self.startup)
+        self._raw_outputs = None
+
+    # -- forward ----------------------------------------------------------
+    def _run_forward(self):
+        if self._raw_outputs is None:
+            fetch = [self.out_vars[s] for s in self.out_slots]
+            outs = self.exe.run(self.main, feed=dict(self.feed),
+                                fetch_list=fetch, return_numpy=False)
+            self._raw_outputs = dict(zip(self.out_slots, outs))
+        return self._raw_outputs
+
+    def outputs(self) -> Dict[str, np.ndarray]:
+        return {s: _dense(o) for s, o in self._run_forward().items()}
+
+    def _in_graph_out_shape(self, slot: str):
+        """Shape of the op's output as the graph sees it: ragged (lod)
+        fetches come back as flat LoDTensors, but in-graph they are
+        padded [batch, T, ...] where T is the input padded length."""
+        raw = self._run_forward()[slot]
+        if hasattr(raw, "to_padded"):
+            t = _dense(next(a for entries in self.inputs.values()
+                            for _, a in entries
+                            if _is_ragged(a))).shape[1]
+            padded, _ = raw.to_padded(max_len=t)
+            return np.asarray(padded).shape
+        return _dense(raw).shape
+
+    def check_output(self, expected: Dict[str, np.ndarray],
+                     atol: float = 1e-5, rtol: float = 1e-5):
+        got = self.outputs()
+        for slot, exp in expected.items():
+            np.testing.assert_allclose(
+                got[slot], np.asarray(exp), atol=atol, rtol=rtol,
+                err_msg=f"{self.op_type} output {slot!r} mismatch")
+
+    # -- gradients --------------------------------------------------------
+    def _loss_program(self, output_slot: str, w: np.ndarray):
+        """Fresh op program + weighted-sum scalar loss (the op_test trick
+        of a fixed random output-grad direction)."""
+        pt.reset_default_programs()
+        main, startup, out_vars = self._append_op_program()
+        with pt.program_guard(main, startup):
+            out = out_vars[output_slot]
+            wv = layers.assign(w.astype(np.float32))
+            prod = layers.elementwise_mul(out, wv)
+            loss = layers.reduce_sum(prod)
+        return main, startup, loss
+
+    def check_grad(self, inputs_to_check: Sequence[str],
+                   output_slot: str = "Out", eps: float = 5e-3,
+                   max_relative_error: float = 5e-3,
+                   seed: int = 7):
+        """inputs_to_check: feed var NAMES. Compares append_backward
+        analytic grads to central finite differences of the same scalar
+        loss (reference: op_test.py check_grad:378)."""
+        out_shape = self._in_graph_out_shape(output_slot)
+        rng = np.random.RandomState(seed)
+        w = rng.uniform(-1, 1, out_shape).astype(np.float32)
+
+        main, startup, loss = self._loss_program(output_slot, w)
+        exe = pt.Executor()
+        exe.run(startup)
+        from paddle_tpu.core.registry import grad_var_name
+        pt.append_backward(loss, program=main)
+        grad_names = [grad_var_name(n) for n in inputs_to_check]
+        analytic = exe.run(main, feed=dict(self.feed),
+                           fetch_list=grad_names, return_numpy=False)
+        analytic = dict(zip(inputs_to_check, analytic))
+
+        # numeric: forward-only program re-run with perturbed feeds
+        fmain, fstartup, floss = self._loss_program(output_slot, w)
+        fexe = pt.Executor()
+        fexe.run(fstartup)
+
+        def loss_at(feed):
+            (l,) = fexe.run(fmain, feed=feed, fetch_list=[floss])
+            return float(np.asarray(_dense(l)).reshape(()))
+
+        for name in inputs_to_check:
+            base = self.feed[name]
+            dense = _dense(base).astype(np.float64)
+            flat = dense.reshape(-1)
+            num = np.zeros_like(flat)
+            for i in range(flat.size):
+                orig = flat[i]
+                flat[i] = orig + eps
+                lp = loss_at(self._perturbed(name, dense))
+                flat[i] = orig - eps
+                lm = loss_at(self._perturbed(name, dense))
+                flat[i] = orig
+                num[i] = (lp - lm) / (2 * eps)
+            numeric = num.reshape(dense.shape)
+            got = analytic[name]
+            if not _is_ragged(base):
+                got = np.asarray(_dense(got), np.float64)
+            if _is_ragged(base):
+                # ragged fetches come back as LoDTensor (flat steps);
+                # re-pad to compare positionwise with the numeric grad
+                if hasattr(got, "to_padded"):
+                    got, _ = got.to_padded(
+                        max_len=_dense(base).shape[1])
+                got = np.asarray(got, np.float64)
+                # padded positions carry no signal; compare valid steps
+                mask = _ragged_mask(base)
+                got = got * mask
+                numeric = numeric * mask
+            denom = np.maximum(
+                np.maximum(np.abs(numeric), np.abs(got)), 1.0)
+            rel = np.abs(got - numeric) / denom
+            assert rel.max() <= max_relative_error, (
+                f"{self.op_type} grad wrt {name!r}: max rel err "
+                f"{rel.max():.2e} at {np.unravel_index(rel.argmax(), rel.shape)} "
+                f"(analytic {got.reshape(-1)[rel.argmax()]:.6f} vs "
+                f"numeric {numeric.reshape(-1)[rel.argmax()]:.6f})")
+
+    def _perturbed(self, name: str, dense: np.ndarray):
+        feed = dict(self.feed)
+        base = self.feed[name]
+        if _is_ragged(base):
+            feed[name] = RaggedPair(
+                dense.astype(_dense(base).dtype), base.lengths)
+        else:
+            feed[name] = dense.astype(np.asarray(base).dtype)
+        return feed
+
+
+def _ragged_mask(rp: RaggedPair) -> np.ndarray:
+    data = np.asarray(rp.data)
+    lengths = np.asarray(rp.lengths)
+    mask = np.zeros(data.shape, np.float64)
+    for b, n in enumerate(lengths):
+        mask[b, :int(n)] = 1.0
+    return mask
