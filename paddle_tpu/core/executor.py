@@ -61,9 +61,22 @@ def _abstractify(value):
 
 def trace_block(block: BlockDesc, env: Dict[str, Any],
                 extra: Dict[str, Any]) -> Dict[str, Any]:
-    """Run every op's compute rule under trace, mutating env. Returns env."""
+    """Run every op's compute rule under trace, mutating env. Returns env.
+
+    Ops annotated by the memory-optimization transpiler carry a
+    __dead_vars__ attr (transpiler/memory_optimization_transpiler.py):
+    those tracers are dropped from env right after the op, shortening
+    tracer lifetimes (XLA does in-executable buffer reuse on its own;
+    this keeps the lowering from pinning dead values). Vars in
+    extra["keep_vars"] (fetches + state writes) always survive."""
+    keep = extra.get("keep_vars") or ()
     for op in block.ops:
         env.update(run_op(op, env, extra))
+        dead = op.attrs.get("__dead_vars__")
+        if dead:
+            for name in dead:
+                if name not in keep:
+                    env.pop(name, None)
     return env
 
 
@@ -136,6 +149,7 @@ class Executor:
             extra = {
                 "program": program,
                 "step": step,
+                "keep_vars": set(fetch_names) | set(write_names),
                 "prng": lambda seed: jax.random.fold_in(
                     jax.random.PRNGKey(seed), step),
             }

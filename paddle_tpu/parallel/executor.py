@@ -71,12 +71,24 @@ class ParallelExecutor(Executor):
                 "program": program,
                 "step": step,
                 "mesh": mesh,
+                "keep_vars": set(fetch_names) | set(write_names),
                 "prng": lambda seed: jax.random.fold_in(
                     jax.random.PRNGKey(seed), step),
             }
             env = trace_block(block, env, extra)
             fetches = [env[n] for n in fetch_names]
-            new_state = {n: env[n] for n in write_names if n in env}
+            # structure must be static (out_shardings is a pytree spec):
+            # returnable_names is computed statically below, with the
+            # unchanged input as fallback for vars only written inside
+            # sub-blocks (which never surface in the parent env)
+            new_state = {}
+            for n in returnable_names:
+                if n in env:
+                    new_state[n] = env[n]
+                elif n in rw_state:
+                    new_state[n] = rw_state[n]
+                else:
+                    new_state[n] = ro_state[n]
             return fetches, new_state
 
         feed_shardings = {}
@@ -90,20 +102,56 @@ class ParallelExecutor(Executor):
                 ndim = len(sig[0])
                 feed_shardings[name] = NamedSharding(
                     mesh, self.sharding.feed_spec(name, ndim))
-        ro_shardings = {
-            n: NamedSharding(mesh, self.sharding.param_spec(n))
-            for n in ro_names}
-        rw_shardings = {
-            n: NamedSharding(mesh, self.sharding.param_spec(n))
-            for n in rw_names}
+        def state_spec(n):
+            """Param spec; optimizer accumulators ({param}_{acc} naming,
+            optimizer.py _add_accumulator) follow their param's sharding
+            when shape-compatible — a replicated default would clash with
+            the GSPMD-propagated sharded outputs on the next call."""
+            if n in self.sharding.specs:
+                return self.sharding.specs[n]
+            best = None
+            for p, sp in self.sharding.specs.items():
+                if n.startswith(p + "_") and \
+                        (best is None or len(p) > len(best[0])):
+                    best = (p, sp)
+            if best is not None:
+                sp = best[1]
+                val = scope.find(n)
+                if val is not None and hasattr(val, "shape") and \
+                        len(val.shape) == len(sp) and all(
+                            ax is None or val.shape[i] %
+                            mesh.shape[ax] == 0
+                            for i, ax in enumerate(sp)):
+                    return sp
+            return self.sharding.default_param
 
-        # Output shardings are left to GSPMD propagation; input shardings
-        # (sharded batch + replicated-or-TP params) fully determine the SPMD
-        # partitioning, including the gradient all-reduce over 'data'.
+        ro_shardings = {
+            n: NamedSharding(mesh, state_spec(n)) for n in ro_names}
+        rw_shardings = {
+            n: NamedSharding(mesh, state_spec(n)) for n in rw_names}
+
+        # Input shardings (sharded batch + replicated-or-TP params)
+        # determine the SPMD partitioning, including the gradient
+        # all-reduce over 'data'. Written-back state is constrained to the
+        # SAME shardings as its inputs — otherwise GSPMD-propagated output
+        # layouts (e.g. a TP layer's bias picking up 'model') would
+        # mismatch the declared in_shardings on the next call.
+        # a write_name is returnable iff some parent-block op outputs it
+        # or we hold its input value to echo back; vars written only in
+        # sub-blocks and never read would have no value to return
+        parent_outs = {n for op in block.ops for n in op.output_names()}
+        read_set = set(read_names)
+        returnable_names = [n for n in write_names
+                            if n in parent_outs or n in read_set]
+        fetch_out = [None] * len(fetch_names)
+        state_out = {n: rw_shardings.get(
+            n, NamedSharding(mesh, state_spec(n)))
+            for n in returnable_names}
         jitted = jax.jit(
             fn,
             in_shardings=(feed_shardings, ro_shardings, rw_shardings,
                           NamedSharding(mesh, P())),
+            out_shardings=(fetch_out, state_out),
             donate_argnums=(2,))
 
         def call(feed_vals, state_vals, step):

@@ -1,0 +1,14 @@
+"""Program transpilers: IR-to-IR / IR-to-sharding passes.
+
+The reference's transpilers rewrite ProgramDescs (reference:
+python/paddle/fluid/distribute_transpiler.py:133 splits params across
+pservers and injects send/recv; memory_optimization_transpiler.py:332
+reuses buffers via liveness analysis). TPU-native: distribution becomes a
+*sharding assignment* consumed by ParallelExecutor (GSPMD inserts the
+collectives the reference's send/recv RPCs did), and memory optimization
+becomes liveness-driven env pruning + donation on top of XLA's own buffer
+assignment.
+"""
+from .distribute_transpiler import DistributeTranspiler  # noqa: F401
+from .memory_optimization_transpiler import (  # noqa: F401
+    ControlFlowGraph, memory_optimize, release_memory)

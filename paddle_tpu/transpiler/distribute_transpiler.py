@@ -1,0 +1,111 @@
+"""DistributeTranspiler: program analysis -> mesh sharding assignment.
+
+Reference behavior (distribute_transpiler.py:133-250): split each param
+into blocks, round-robin them across pservers, rewrite the trainer
+program with send/recv ops, and emit a pserver program of optimize
+sub-blocks. The TPU-native redesign keeps the *decision* layer (which
+param lives where) and replaces the *mechanism*: instead of pserver RPC,
+it emits a ShardingSpec over a named mesh — GSPMD then inserts
+all-reduce/all-gather over ICI where the reference sent gRPC messages
+(SURVEY.md §2 parallelism table). Sparse/EP: large embedding tables are
+row-sharded over the model axis, the collective analog of the
+reference's distributed lookup table + prefetch (prefetch_op.cc,
+split_ids_op.cc).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..framework import Program
+from ..parallel.executor import ShardingSpec
+
+
+class DistributeTranspiler:
+    """Analyze a program; produce a ShardingSpec for ParallelExecutor.
+
+    Heuristics (all overridable via explicit `overrides`):
+      - embedding tables (lookup_table W) with >= ep_threshold rows:
+        row-sharded over `model_axis` (EP / distributed lookup table)
+      - 2-D matmul/fc weights with out_features divisible by the model
+        axis size and >= tp_threshold: column-sharded (TP), matching
+        ParallelNeuralNetwork's layer-device model parallelism
+      - everything else: replicated; the batch rides `data_axis` (DP)
+    """
+
+    def __init__(self, data_axis: str = "data", model_axis: str = "model",
+                 tp_threshold: int = 1 << 16,
+                 ep_threshold: int = 1 << 14):
+        self.data_axis = data_axis
+        self.model_axis = model_axis
+        self.tp_threshold = tp_threshold
+        self.ep_threshold = ep_threshold
+        self._spec: Optional[ShardingSpec] = None
+        self.decisions: Dict[str, str] = {}
+
+    # -- reference-compat entry point -------------------------------------
+    def transpile(self, program: Program, mesh=None,
+                  trainer_id: int = 0, trainers: int = 1,
+                  pservers: Optional[str] = None,
+                  overrides: Optional[Dict[str, P]] = None
+                  ) -> ShardingSpec:
+        """`trainer_id/trainers/pservers` are accepted for source
+        compatibility with reference scripts; the placement they chose by
+        hand is decided here from the program + mesh."""
+        model_par = 1
+        if mesh is not None and self.model_axis in mesh.axis_names:
+            model_par = int(mesh.shape[self.model_axis])
+
+        specs: Dict[str, P] = {}
+        lookup_tables = self._lookup_table_params(program)
+        for p in program.all_parameters():
+            shape = tuple(p.shape or ())
+            numel = int(np.prod(shape)) if shape else 0
+            if p.name in lookup_tables and model_par > 1 and \
+                    shape and shape[0] >= self.ep_threshold and \
+                    shape[0] % model_par == 0:
+                specs[p.name] = P(self.model_axis, None)
+                self.decisions[p.name] = "ep-row-shard"
+            elif len(shape) == 2 and model_par > 1 and \
+                    numel >= self.tp_threshold and \
+                    shape[1] % model_par == 0 and \
+                    p.name not in lookup_tables:
+                specs[p.name] = P(None, self.model_axis)
+                self.decisions[p.name] = "tp-col-shard"
+            else:
+                self.decisions[p.name] = "replicated"
+        self._spec = ShardingSpec(specs=specs, feed_axis=self.data_axis)
+        if overrides:
+            self._spec.specs.update(overrides)
+        return self._spec
+
+    def sharding_spec(self) -> ShardingSpec:
+        if self._spec is None:
+            raise RuntimeError("call transpile() first")
+        return self._spec
+
+    def get_trainer_program(self, program: Program) -> Program:
+        """SPMD: every host runs the same program; the spec does the
+        splitting (the reference instead rewrote it with send/recv)."""
+        return program
+
+    def get_pserver_program(self, endpoint=None, program=None):
+        raise NotImplementedError(
+            "pserver processes do not exist on TPU: dense updates ride "
+            "GSPMD all-reduce over ICI and sparse tables are row-sharded "
+            "in-graph (see transpile()); for the fault-tolerant data "
+            "dispatch half of the pserver design, use "
+            "paddle_tpu.distributed.MasterServer")
+
+    # -- helpers ----------------------------------------------------------
+    @staticmethod
+    def _lookup_table_params(program: Program) -> set:
+        names = set()
+        for block in program.desc.blocks:
+            for op in block.ops:
+                if op.type in ("lookup_table", "embedding"):
+                    for n in op.input("W"):
+                        names.add(n)
+        return names
