@@ -29,5 +29,6 @@ from .parallel import ParallelExecutor  # noqa
 from . import reader  # noqa
 from .reader import batch  # noqa
 from . import concurrency  # noqa
+from . import amp  # noqa
 
 __version__ = "0.1.0"

@@ -1,0 +1,60 @@
+"""Automatic mixed precision for the TPU MXU.
+
+Capability-equivalent of the reference's float16 support
+(reference: paddle/fluid/platform/float16.h:64 — a 913-LoC software fp16
+type threaded through kernels), redesigned for TPU: the natural reduced
+precision is bfloat16, and instead of per-kernel fp16 code paths, a single
+global/context switch makes the FLOP-dominant ops (conv, matmul) cast their
+operands to bf16 while accumulating in float32 (`preferred_element_type`),
+which maps each op onto a single MXU pass. Parameters, optimizer state, and
+normalization statistics stay float32 — the standard master-weight recipe.
+
+Enable per process with env PADDLE_TPU_AMP=1, or scoped:
+
+    with paddle_tpu.amp.amp_guard():
+        exe.run(main_program, ...)
+
+(The guard must wrap the FIRST run that compiles the program — precision is
+baked into the compiled executable, keyed by the amp flag in the executor's
+cache key.)
+"""
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+_state = {"enabled": os.environ.get("PADDLE_TPU_AMP", "0") == "1"}
+
+
+def amp_enabled() -> bool:
+    return _state["enabled"]
+
+
+def enable(flag: bool = True) -> None:
+    _state["enabled"] = bool(flag)
+
+
+@contextmanager
+def amp_guard(enabled: bool = True):
+    prev = _state["enabled"]
+    _state["enabled"] = bool(enabled)
+    try:
+        yield
+    finally:
+        _state["enabled"] = prev
+
+
+def amp_cast(*arrays):
+    """Cast float32 operands to bfloat16 when AMP is on; pass through else.
+
+    Only f32 is downcast — integer/bool/f64/bf16 operands are untouched, so
+    ops can call this unconditionally.
+    """
+    if not _state["enabled"]:
+        return arrays if len(arrays) > 1 else arrays[0]
+    out = tuple(a.astype(jnp.bfloat16)
+                if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+                for a in arrays)
+    return out if len(out) > 1 else out[0]

@@ -11,8 +11,18 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..amp import amp_cast
 from ..core.registry import register_op
 from .core_ops import jnp_dtype
+
+
+def _mxu_matmul(x, y):
+    """matmul that engages the MXU in one pass under AMP: bf16 operands,
+    float32 accumulation, float32 result."""
+    out_dtype = jnp.promote_types(x.dtype, y.dtype)
+    x, y = amp_cast(x, y)
+    pref = jnp.float32 if x.dtype == jnp.bfloat16 == y.dtype else None
+    return jnp.matmul(x, y, preferred_element_type=pref).astype(out_dtype)
 
 
 def _broadcast_y(x, y, axis: int):
@@ -57,7 +67,7 @@ def _mul(ctx):
     yn = ctx.attr("y_num_col_dims", 1)
     x2 = x.reshape((_prod(x.shape[:xn]), _prod(x.shape[xn:])))
     y2 = y.reshape((_prod(y.shape[:yn]), _prod(y.shape[yn:])))
-    out = x2 @ y2
+    out = _mxu_matmul(x2, y2)
     out_shape = tuple(x.shape[:xn]) + tuple(y.shape[yn:])
     ctx.set_output("Out", out.reshape(out_shape))
 
@@ -77,7 +87,7 @@ def _matmul(ctx):
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if ctx.attr("transpose_Y", False):
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
-    out = jnp.matmul(x, y)
+    out = _mxu_matmul(x, y)
     alpha = ctx.attr("alpha", 1.0)
     if alpha != 1.0:
         out = out * alpha

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..amp import amp_cast
 from ..core.registry import register_op
 from .core_ops import jnp_dtype, _op_key
 
@@ -26,6 +27,12 @@ def _pair(v):
 # -- convolution ------------------------------------------------------------
 
 def _conv2d_impl(x, w, strides, paddings, dilations, groups):
+    # Under AMP both operands drop to bf16; the MXU still accumulates in
+    # f32 internally, so only the final rounding is bf16 — then cast back.
+    # (preferred_element_type=f32 would keep the f32 rounding but its conv
+    # transpose rule rejects mixed-dtype cotangents, so full-bf16 it is.)
+    out_dtype = x.dtype
+    x, w = amp_cast(x, w)
     return jax.lax.conv_general_dilated(
         x, w,
         window_strides=strides,
@@ -33,8 +40,7 @@ def _conv2d_impl(x, w, strides, paddings, dilations, groups):
         rhs_dilation=dilations,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
-    ).astype(x.dtype)
+    ).astype(out_dtype)
 
 
 @register_op("conv2d")

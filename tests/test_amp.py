@@ -1,0 +1,85 @@
+"""Mixed precision (paddle_tpu.amp): bf16 compute, f32 state.
+
+Reference capability: fp16 kernels via platform/float16.h; here the TPU
+recipe is bf16 operands on the MXU with f32 master weights (amp.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    yield
+    pt.amp.enable(False)
+
+
+def _build_mlp_train():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8], dtype="float32")
+        label = layers.data("label", [1], dtype="int32")
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=4, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        from paddle_tpu.optimizer import SGD
+        SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_amp_training_matches_fp32_loosely():
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    label = rng.randint(0, 4, (16, 1)).astype(np.int32)
+
+    losses = {}
+    for amp_on in (False, True):
+        pt.reset_default_programs()
+        pt.reset_global_scope()
+        np.random.seed(0)
+        main, startup, loss = _build_mlp_train()
+        exe = pt.Executor()
+        exe.run(startup)
+        with pt.amp.amp_guard(amp_on):
+            for _ in range(5):
+                (lv,) = exe.run(main, feed={"x": x, "label": label},
+                                fetch_list=[loss])
+        losses[amp_on] = float(np.asarray(lv))
+    assert np.isfinite(losses[True])
+    # bf16 has ~3 decimal digits; training curves should agree loosely.
+    assert abs(losses[True] - losses[False]) < 0.15 * (abs(losses[False]) + 1)
+
+
+def test_amp_params_stay_float32():
+    pt.amp.enable(True)
+    main, startup, loss = _build_mlp_train()
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    exe.run(main, feed={"x": rng.randn(4, 8).astype(np.float32),
+                        "label": np.zeros((4, 1), np.int32)},
+            fetch_list=[loss])
+    scope = pt.global_scope()
+    params = [v for v in main.desc.global_block.vars.values()
+              if getattr(v, "persistable", False)]
+    assert params
+    for v in params:
+        arr = scope.find(v.name)
+        if arr is not None and hasattr(arr, "dtype") and \
+                np.issubdtype(np.asarray(arr).dtype, np.floating):
+            assert np.asarray(arr).dtype == np.float32
+
+
+def test_feed_cache_reuses_frozen_arrays():
+    from paddle_tpu.core.executor import _to_device_value
+    a = np.ones((4, 4), np.float32)
+    a.flags.writeable = False
+    d1 = _to_device_value(a)
+    d2 = _to_device_value(a)
+    assert d1 is d2
+    b = np.ones((4, 4), np.float32)  # writeable: must NOT be cached
+    assert _to_device_value(b) is not _to_device_value(b)
