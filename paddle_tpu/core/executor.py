@@ -41,7 +41,7 @@ CHECK_NAN_INF = os.environ.get("PADDLE_TPU_CHECK_NAN_INF", "0") == "1"
 # (e.g. np.broadcast_to, or a frozen slice) can still change through its
 # writeable base, which would silently serve stale device data. Freezing
 # an owning array is the caller's immutability contract. DataFeeder
-# freezes its outputs, so framework-produced feeds are cached automatically.
+# freezes its outputs when constructed with freeze=True.
 _feed_cache: Dict[int, Tuple[Any, Any]] = {}
 _FEED_CACHE_MAX = int(os.environ.get("PADDLE_TPU_FEED_CACHE_MAX", "8"))
 

@@ -290,6 +290,17 @@ class Program:
     def from_json(cls, s: str) -> "Program":
         return cls.from_dict(json.loads(s))
 
+    def save_binary(self, path: str) -> None:
+        """Write the compact PTIR binary via the native IR (native/ir.cc) —
+        the on-disk `__model__` format of save_inference_model."""
+        from ..native import ProgramIR
+        ProgramIR.from_json(self.to_json()).save(path)
+
+    @classmethod
+    def load_binary(cls, path: str) -> "Program":
+        from ..native import ProgramIR
+        return cls.from_json(ProgramIR.load(path).to_json())
+
     def clone(self) -> "Program":
         return Program.from_dict(copy.deepcopy(self.to_dict()))
 

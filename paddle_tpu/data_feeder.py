@@ -46,7 +46,10 @@ class DataFeeder:
                     arr = arr.reshape(len(column), *[
                         d for d in shape[1:] if d and d > 0] or [1])
                 if self.freeze:
-                    arr = np.ascontiguousarray(arr)  # own buffer, cacheable
+                    # the executor only caches frozen OWNING arrays; reshape
+                    # yields views (owndata=False), so materialize first
+                    if not arr.flags.owndata:
+                        arr = arr.copy()
                     arr.flags.writeable = False
                 out[name] = arr
         return out

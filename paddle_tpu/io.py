@@ -86,16 +86,23 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     framework/prune.cc pruning)."""
     program = main_program or default_main_program()
     os.makedirs(dirname, exist_ok=True)
-    pruned = _prune(program, feeded_var_names,
-                    [t.name for t in target_vars])
-    meta = {
-        "program": pruned.desc.to_dict(),
-        "feed_names": list(feeded_var_names),
-        "fetch_names": [t.name for t in target_vars],
-    }
-    with open(os.path.join(dirname, model_filename or "__model__.json"),
-              "w") as f:
-        json.dump(meta, f)
+    fetch_names = [t.name for t in target_vars]
+    pruned = _prune(program, feeded_var_names, fetch_names)
+    # The program itself ships as compact PTIR binary written by the native
+    # IR library (native/ir.cc), like the reference's protobuf __model__
+    # (reference: io.py:298 writes program.desc.serialize_to_string()).
+    meta = dict(pruned.desc.to_dict())  # top-level "blocks" + extras
+    meta["feed_names"] = list(feeded_var_names)
+    meta["fetch_names"] = fetch_names
+    try:
+        from .native import ProgramIR
+        ProgramIR.from_json(json.dumps(meta)).save(
+            os.path.join(dirname, model_filename or "__model__"))
+    except Exception:
+        # no native toolchain on this host: text-JSON fallback
+        with open(os.path.join(dirname,
+                               model_filename or "__model__.json"), "w") as f:
+            json.dump(meta, f)
     save_persistables(executor, dirname, program,
                       filename=params_filename or "__params__.npz")
     return dirname
@@ -103,11 +110,19 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None):
-    with open(os.path.join(dirname, model_filename or "__model__.json")) as f:
-        meta = json.load(f)
+    bin_path = os.path.join(dirname, model_filename or "__model__")
+    json_path = os.path.join(dirname, model_filename or "__model__.json")
+    if os.path.exists(bin_path):
+        from .native import ProgramIR
+        meta = json.loads(ProgramIR.load(bin_path).to_json())
+    else:  # models saved by the JSON fallback (or older versions)
+        with open(json_path) as f:
+            meta = json.load(f)
+        meta = meta.get("program", meta) | {
+            k: meta[k] for k in ("feed_names", "fetch_names") if k in meta}
     from .core import ir
     prog = Program()
-    prog.desc = ir.Program.from_dict(meta["program"])
+    prog.desc = ir.Program.from_dict(meta)
     from .framework import Block
     prog._blocks = [Block(prog, bd) for bd in prog.desc.blocks]
     load_vars(executor, dirname, prog,
@@ -118,19 +133,36 @@ def load_inference_model(dirname, executor, model_filename=None,
 
 
 def _prune(program: Program, feed_names, fetch_names) -> Program:
-    """Keep only ops needed to compute fetch_names from feed_names
-    (reference: framework/prune.cc)."""
-    pruned = program.clone()
-    block = pruned.desc.global_block
+    """Keep only ops needed to compute fetch_names from feed_names — the
+    backward slice runs in the native IR library (native/ir.cc
+    prune_program; reference: framework/prune.cc, also C++ there).
+    Persistable vars (parameters) are roots: their values come from the
+    loaded checkpoint, so their producers (optimizer update ops, which
+    *output* the param) must not pull the training graph back in."""
+    from .core import ir
+    from .framework import Block
+    try:
+        from .native import ProgramIR
+        handle = ProgramIR.from_json(program.desc.to_json())
+        pruned_desc = ir.Program.from_json(
+            handle.prune(feed_names, fetch_names).to_json())
+    except Exception:
+        pruned_desc = _prune_py(program, fetch_names)
+    pruned = Program()
+    pruned.desc = pruned_desc
+    pruned._blocks = [Block(pruned, bd) for bd in pruned.desc.blocks]
+    return pruned
 
-    def _persistable(name: str) -> bool:
+
+def _prune_py(program: Program, fetch_names):
+    """Pure-Python fallback with identical semantics to native prune."""
+    desc = program.desc.clone()
+    block = desc.global_block
+
+    def _persistable(name):
         v = block.find_var_recursive(name)
         return v is not None and v.persistable
 
-    # Backward walk from the fetch targets. Persistable vars (parameters)
-    # are roots: their values come from the loaded checkpoint, so their
-    # producers (optimizer update ops, which *output* the param) must not
-    # pull the training graph back in.
     needed = set(fetch_names)
     keep = []
     for op in reversed(block.ops):
@@ -142,8 +174,8 @@ def _prune(program: Program, feed_names, fetch_names) -> Program:
                 if not _persistable(n):
                     needed.add(n)
     block.ops = list(reversed(keep))
-    pruned.desc._bump_version()
-    return pruned
+    desc._bump_version()
+    return desc
 
 
 def get_parameter_value(para, executor=None):

@@ -99,8 +99,82 @@ def lib() -> ctypes.CDLL:
         l.ms_recover.restype = ctypes.c_int
         l.ms_recover.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                  ctypes.c_uint64]
+
+        # program IR (native/ir.cc)
+        l.ir_last_error.restype = ctypes.c_char_p
+        l.ir_from_json.restype = ctypes.c_void_p
+        l.ir_from_json.argtypes = [ctypes.c_char_p]
+        l.ir_to_json.restype = ctypes.POINTER(ctypes.c_char)
+        l.ir_to_json.argtypes = [ctypes.c_void_p]
+        l.ir_free.argtypes = [ctypes.c_void_p]
+        l.ir_free_str.argtypes = [ctypes.POINTER(ctypes.c_char)]
+        l.ir_save.restype = ctypes.c_int
+        l.ir_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        l.ir_load.restype = ctypes.c_void_p
+        l.ir_load.argtypes = [ctypes.c_char_p]
+        l.ir_prune.restype = ctypes.c_void_p
+        l.ir_prune.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_char_p]
+        l.ir_liveness.restype = ctypes.POINTER(ctypes.c_char)
+        l.ir_liveness.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        l.ir_validate.restype = ctypes.POINTER(ctypes.c_char)
+        l.ir_validate.argtypes = [ctypes.c_void_p]
         _lib = l
     return _lib
+
+
+def _ir_take_str(ptr) -> str:
+    """Copy a malloc'd char* result and free it via ir_free_str."""
+    s = ctypes.cast(ptr, ctypes.c_char_p).value.decode()
+    lib().ir_free_str(ptr)
+    return s
+
+
+class ProgramIR:
+    """Native program handle (native/ir.cc). Methods mirror the C ABI:
+    JSON <-> native graph, PTIR binary save/load, prune, liveness,
+    validate. Raises RuntimeError with ir_last_error on failure."""
+
+    def __init__(self, handle):
+        if not handle:
+            raise RuntimeError("native ir: "
+                               + lib().ir_last_error().decode())
+        self._h = handle
+
+    @classmethod
+    def from_json(cls, text: str) -> "ProgramIR":
+        return cls(lib().ir_from_json(text.encode()))
+
+    @classmethod
+    def load(cls, path: str) -> "ProgramIR":
+        return cls(lib().ir_load(str(path).encode()))
+
+    def to_json(self) -> str:
+        return _ir_take_str(lib().ir_to_json(self._h))
+
+    def save(self, path: str) -> None:
+        if lib().ir_save(self._h, str(path).encode()) != 0:
+            raise RuntimeError("native ir save: "
+                               + lib().ir_last_error().decode())
+
+    def prune(self, feed_names, fetch_names) -> "ProgramIR":
+        return ProgramIR(lib().ir_prune(
+            self._h, "\n".join(feed_names).encode(),
+            "\n".join(fetch_names).encode()))
+
+    def liveness(self, skip_names=()) -> list:
+        import json as _json
+        return _json.loads(_ir_take_str(lib().ir_liveness(
+            self._h, "\n".join(skip_names).encode())))
+
+    def validate(self) -> str:
+        """Empty string when the program is well-formed."""
+        return _ir_take_str(lib().ir_validate(self._h))
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h and _lib is not None:
+            _lib.ir_free(h)
 
 
 def last_error() -> str:

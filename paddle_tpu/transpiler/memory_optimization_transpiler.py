@@ -84,23 +84,40 @@ def _sub_block_refs(program: Program) -> Set[str]:
     return refs
 
 
+def _dead_after_lists(input_program: Program, skip: Set[str]):
+    """Per-op releasable-var lists for the global block. The analysis runs
+    in the native IR library (native/ir.cc liveness_program — including the
+    conservative sub-block protection); the Python ControlFlowGraph below
+    is the documented fallback if the native build is unavailable."""
+    try:
+        from ..native import ProgramIR
+        handle = ProgramIR.from_json(input_program.desc.to_json())
+        return [set(names) for names in handle.liveness(sorted(skip))]
+    except Exception:
+        block = input_program.desc.global_block
+        dead = ControlFlowGraph(block).dead_after()
+        out = []
+        for dead_set in dead:
+            releasable = set()
+            for name in dead_set:
+                v = block.find_var_recursive(name)
+                if v is None or v.persistable or name in skip:
+                    continue
+                releasable.add(name)
+            out.append(releasable)
+        return out
+
+
 def memory_optimize(input_program: Program, skip_opt_set: Optional[Set]
                     = None, print_log: bool = False, level: int = 0):
     """Annotate global-block ops with their dead-after var sets (in
     place). Sub-blocks are not annotated, and any var a sub-block might
-    reference stays live (see _sub_block_refs)."""
+    reference stays live (native liveness_program / _sub_block_refs)."""
     skip = set(skip_opt_set or ()) | _sub_block_refs(input_program)
     stats = {"annotated_ops": 0, "released_vars": 0}
     block = input_program.desc.global_block
-    cfg = ControlFlowGraph(block)
-    dead = cfg.dead_after()
-    for op, dead_set in zip(block.ops, dead):
-        releasable = set()
-        for name in dead_set:
-            v = block.find_var_recursive(name)
-            if v is None or v.persistable or name in skip:
-                continue
-            releasable.add(name)
+    for op, releasable in zip(block.ops, _dead_after_lists(input_program,
+                                                           skip)):
         if releasable:
             op.attrs[DEAD_VARS_ATTR] = sorted(releasable)
             stats["annotated_ops"] += 1
