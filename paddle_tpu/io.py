@@ -112,10 +112,16 @@ def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None):
     bin_path = os.path.join(dirname, model_filename or "__model__")
     json_path = os.path.join(dirname, model_filename or "__model__.json")
+    meta = None
     if os.path.exists(bin_path):
-        from .native import ProgramIR
-        meta = json.loads(ProgramIR.load(bin_path).to_json())
-    else:  # models saved by the JSON fallback (or older versions)
+        with open(bin_path, "rb") as f:
+            is_ptir = f.read(4) == b"PTIR"
+        if is_ptir:
+            from .native import ProgramIR
+            meta = json.loads(ProgramIR.load(bin_path).to_json())
+        else:  # custom model_filename written by the JSON fallback
+            json_path = bin_path
+    if meta is None:  # models saved by the JSON fallback (or older versions)
         with open(json_path) as f:
             meta = json.load(f)
         meta = meta.get("program", meta) | {

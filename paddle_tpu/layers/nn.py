@@ -35,6 +35,9 @@ __all__ = [
     "swish", "hard_sigmoid", "relu6", "soft_relu", "flatten", "gelu",
     "beam_search", "beam_search_decode", "increment", "cumsum",
     "linear_chain_crf", "crf_decoding",
+    "multiplex", "lstm_unit", "gru_unit", "dynamic_lstmp",
+    "ctc_greedy_decoder", "chunk_eval", "autoincreased_step_counter",
+    "lod_reset", "prelu", "label_smooth", "rank_loss", "roi_pool",
 ]
 
 
@@ -996,3 +999,202 @@ def crf_decoding(input, param_attr=None, label=None):
     helper.append_op(type="crf_decoding", inputs=inputs,
                      outputs={"ViterbiPath": path})
     return path
+
+
+def multiplex(inputs, index):
+    """Select rows among candidates by index (reference: nn.py multiplex)."""
+    helper = LayerHelper("multiplex")
+    out = helper.create_tmp_variable(inputs[0].dtype)
+    helper.append_op(type="multiplex",
+                     inputs={"X": list(inputs), "Ids": index},
+                     outputs={"Out": out})
+    return out
+
+
+# -- single-step RNN cells (reference: nn.py lstm_unit:  gru_unit:) ---------
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """One LSTM step: projects [x_t, h_prev] to 4*d gates then applies the
+    cell update (reference: nn.py lstm_unit — built on lstm_unit op)."""
+    helper = LayerHelper("lstm_unit", name=name)
+    d = cell_t_prev.shape[-1]
+    concat_in = fc(x_t, size=4 * d, bias_attr=bias_attr,
+                   param_attr=param_attr)
+    h_proj = fc(hidden_t_prev, size=4 * d, bias_attr=False)
+    gates = helper.create_tmp_variable(x_t.dtype)
+    helper.append_op(type="elementwise_add",
+                     inputs={"X": concat_in, "Y": h_proj},
+                     outputs={"Out": gates})
+    c = helper.create_tmp_variable(x_t.dtype)
+    h = helper.create_tmp_variable(x_t.dtype)
+    helper.append_op(type="lstm_unit",
+                     inputs={"X": gates, "C_prev": cell_t_prev},
+                     outputs={"C": c, "H": h},
+                     attrs={"forget_bias": float(forget_bias)})
+    return h, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid"):
+    """One GRU step (reference: nn.py gru_unit). size = 3*d."""
+    helper = LayerHelper("gru_unit")
+    d = size // 3
+    weight = helper.create_parameter(attr=param_attr, shape=[d, 3 * d],
+                                     dtype=input.dtype)
+    bias = helper.create_parameter(attr=bias_attr, shape=[1, 3 * d],
+                                   dtype=input.dtype, is_bias=True)
+    gate = helper.create_tmp_variable(input.dtype)
+    reset_h = helper.create_tmp_variable(input.dtype)
+    hid = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="gru_unit",
+                     inputs={"Input": input, "HiddenPrev": hidden,
+                             "Weight": weight, "Bias": bias},
+                     outputs={"Gate": gate, "ResetHiddenPrev": reset_h,
+                              "Hidden": hid},
+                     attrs={"activation": activation,
+                            "gate_activation": gate_activation})
+    return hid, reset_h, gate
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=False, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  name=None):
+    """Projected LSTM over ragged input (reference: nn.py dynamic_lstmp:
+    input already projected to [*, 4*d]; recurrence on the p-dim
+    projection)."""
+    helper = LayerHelper("dynamic_lstmp", name=name)
+    d = size // 4
+    weight = helper.create_parameter(attr=param_attr, shape=[proj_size, 4 * d],
+                                     dtype=input.dtype)
+    proj_weight = helper.create_parameter(attr=param_attr,
+                                          shape=[d, proj_size],
+                                          dtype=input.dtype)
+    # peepholes pack W_ic/W_fc/W_oc after the gate bias (reference layout)
+    bias_size = 7 * d if use_peepholes else 4 * d
+    bias = helper.create_parameter(attr=bias_attr, shape=[1, bias_size],
+                                   dtype=input.dtype, is_bias=True)
+    proj = helper.create_tmp_variable(input.dtype, lod_level=input.lod_level)
+    cell = helper.create_tmp_variable(input.dtype, lod_level=input.lod_level)
+    last_h = helper.create_tmp_variable(input.dtype)
+    last_c = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="lstmp",
+                     inputs={"Input": input, "Weight": weight,
+                             "ProjWeight": proj_weight, "Bias": bias},
+                     outputs={"Projection": proj, "Cell": cell,
+                              "LastH": last_h, "LastC": last_c},
+                     attrs={"gate_activation": gate_activation,
+                            "cell_activation": cell_activation,
+                            "candidate_activation": candidate_activation,
+                            "proj_activation": proj_activation,
+                            "use_peepholes": use_peepholes,
+                            "is_reverse": is_reverse})
+    return proj, cell
+
+
+# -- decode/eval wrappers ---------------------------------------------------
+
+def ctc_greedy_decoder(input, blank, name=None):
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    out = helper.create_tmp_variable("int32", lod_level=1)
+    helper.append_op(type="ctc_greedy_decoder", inputs={"Input": input},
+                     outputs={"Out": out}, attrs={"blank": blank})
+    return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    helper = LayerHelper("chunk_eval")
+    precision = helper.create_tmp_variable("float32")
+    recall = helper.create_tmp_variable("float32")
+    f1 = helper.create_tmp_variable("float32")
+    num_infer = helper.create_tmp_variable("int64")
+    num_label = helper.create_tmp_variable("int64")
+    num_correct = helper.create_tmp_variable("int64")
+    helper.append_op(type="chunk_eval",
+                     inputs={"Inference": input, "Label": label},
+                     outputs={"Precision": precision, "Recall": recall,
+                              "F1-Score": f1, "NumInferChunks": num_infer,
+                              "NumLabelChunks": num_label,
+                              "NumCorrectChunks": num_correct},
+                     attrs={"num_chunk_types": num_chunk_types,
+                            "chunk_scheme": chunk_scheme,
+                            "excluded_chunk_types":
+                                list(excluded_chunk_types or [])})
+    return precision, recall, f1, num_infer, num_label, num_correct
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Persistent int64 step counter incremented per run (reference:
+    nn.py autoincreased_step_counter)."""
+    helper = LayerHelper("global_step_counter")
+    counter = helper.create_global_variable(
+        shape=[1], dtype="int64", name=counter_name or "@STEP_COUNTER@",
+        persistable=True)
+    helper.set_variable_initializer(
+        counter, ConstantInitializer(float(begin - step)))
+    helper.append_op(type="increment", inputs={"X": counter},
+                     outputs={"Out": counter}, attrs={"step": float(step)})
+    counter.stop_gradient = True
+    return counter
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """Reassign sequence boundaries (reference: nn.py lod_reset)."""
+    helper = LayerHelper("lod_reset")
+    out = helper.create_tmp_variable(x.dtype, lod_level=1)
+    inputs = {"X": x}
+    if y is not None:
+        inputs["Y"] = y
+    helper.append_op(type="lod_reset", inputs=inputs, outputs={"Out": out},
+                     attrs={"target_lod": list(target_lod or [])})
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    helper = LayerHelper("prelu", name=name)
+    alpha_len = x.shape[1] if mode == "channel" else 1
+    alpha = helper.create_parameter(attr=param_attr, shape=[alpha_len],
+                                    dtype=x.dtype,
+                                    default_initializer=ConstantInitializer(
+                                        0.25))
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="prelu", inputs={"X": x, "Alpha": alpha},
+                     outputs={"Out": out}, attrs={"mode": mode})
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_tmp_variable(dtype)
+    inputs = {"X": label}
+    if prior_dist is not None:
+        inputs["PriorDist"] = prior_dist
+    helper.append_op(type="label_smooth", inputs=inputs,
+                     outputs={"Out": out}, attrs={"epsilon": float(epsilon)})
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    helper = LayerHelper("rank_loss", name=name)
+    out = helper.create_tmp_variable("float32")
+    helper.append_op(type="rank_loss",
+                     inputs={"Label": label, "Left": left, "Right": right},
+                     outputs={"Out": out})
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0):
+    helper = LayerHelper("roi_pool")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="roi_pool",
+                     inputs={"X": input, "ROIs": rois},
+                     outputs={"Out": out},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale})
+    return out

@@ -341,3 +341,153 @@ def _cos_sim(ctx):
     ctx.set_output("Out", out)
     ctx.set_output("XNorm", xn)
     ctx.set_output("YNorm", yn)
+
+
+# -- remaining activation surface (reference: activation_op.cc) -------------
+
+_register_act("stanh", lambda x: 1.7159 * jnp.tanh(0.66667 * x))
+
+
+@register_op("brelu")
+def _brelu(ctx):
+    x = ctx.input("X")
+    t_min = ctx.attr("t_min", 0.0)
+    t_max = ctx.attr("t_max", 24.0)
+    ctx.set_output("Out", jnp.clip(x, t_min, t_max))
+
+
+@register_op("hard_shrink")
+def _hard_shrink(ctx):
+    x = ctx.input("X")
+    t = ctx.attr("threshold", 0.5)
+    ctx.set_output("Out", jnp.where(jnp.abs(x) > t, x, 0.0))
+
+
+@register_op("softshrink")
+def _softshrink(ctx):
+    x = ctx.input("X")
+    lam = ctx.attr("lambda", 0.5)
+    ctx.set_output("Out", jnp.where(x > lam, x - lam,
+                                    jnp.where(x < -lam, x + lam, 0.0)))
+
+
+@register_op("thresholded_relu")
+def _thresholded_relu(ctx):
+    x = ctx.input("X")
+    t = ctx.attr("threshold", 1.0)
+    ctx.set_output("Out", jnp.where(x > t, x, 0.0))
+
+
+@register_op("prelu")
+def _prelu(ctx):
+    """PReLU with learned slope (reference: prelu_op.cc — 'all' mode
+    shares one alpha; 'channel' mode one per channel dim 1)."""
+    x = ctx.input("X")
+    alpha = ctx.input("Alpha")
+    mode = ctx.attr("mode", "all")
+    if mode == "channel" and x.ndim >= 2:
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    else:
+        alpha = alpha.reshape((1,) * x.ndim)
+    ctx.set_output("Out", jnp.where(x > 0, x, alpha * x))
+
+
+@register_op("label_smooth", no_grad_slots=["PriorDist"])
+def _label_smooth(ctx):
+    """(1-eps)*label + eps*prior (uniform when no prior);
+    reference: label_smooth_op.cc."""
+    x = ctx.input("X")
+    eps = ctx.attr("epsilon", 0.0)
+    prior = ctx.input("PriorDist")
+    if prior is None:
+        prior = 1.0 / x.shape[-1]
+    ctx.set_output("Out", (1.0 - eps) * x + eps * prior)
+
+
+# -- remaining losses (reference: *_loss_op.cc) -----------------------------
+
+@register_op("modified_huber_loss", no_grad_slots=["Y"])
+def _modified_huber_loss(ctx):
+    """Classification Huber loss on y in {0,1} (reference:
+    modified_huber_loss_op.cc): z = 2y-1; yv = z*pred;
+    loss = (1-yv)^2 clipped quadratic for yv >= -1 else -4*yv."""
+    x = ctx.input("X")
+    y = ctx.input("Y").astype(x.dtype)
+    yv = (2.0 * y - 1.0) * x
+    loss = jnp.where(yv < -1.0, -4.0 * yv,
+                     jnp.square(jnp.maximum(0.0, 1.0 - yv)))
+    ctx.set_output("IntermediateVal", yv)
+    ctx.set_output("Out", loss)
+
+
+@register_op("rank_loss", no_grad_slots=["Label"])
+def _rank_loss(ctx):
+    """Pairwise ranking loss (reference: rank_loss_op.cc):
+    C = -label*(l-r) + log(1+exp(l-r))."""
+    label = ctx.input("Label")
+    left = ctx.input("Left")
+    right = ctx.input("Right")
+    d = left - right
+    ctx.set_output("Out", -label * d + jnp.logaddexp(0.0, d))
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    diff = x - y.reshape(y.shape if y.shape[0] == x.shape[0]
+                         else (1,) + tuple(y.shape[1:]))
+    ctx.set_output("sub_result", diff)
+    ctx.set_output("Out", jnp.sum(jnp.square(diff), axis=-1, keepdims=True))
+
+
+@register_op("l1_norm")
+def _l1_norm(ctx):
+    ctx.set_output("Out", jnp.sum(jnp.abs(ctx.input("X"))))
+
+
+@register_op("norm")
+def _norm(ctx):
+    """L2-normalize along channel dim 1 with learned scale (reference:
+    norm_op.cc — out = scale_c * x / ||x||_2 over channels)."""
+    x = ctx.input("X")
+    scale = ctx.input("Scale")
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True) + 1e-10)
+    scale = scale.reshape((1, -1) + (1,) * (x.ndim - 2))
+    ctx.set_output("Out", scale * x / norm)
+
+
+# -- misc parity ops --------------------------------------------------------
+
+@register_op("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx):
+    """out[:, k] = x @ W_k @ y^T diag + bias (reference:
+    bilinear_tensor_product_op.cc)."""
+    x = ctx.input("X")          # [n, dx]
+    y = ctx.input("Y")          # [n, dy]
+    w = ctx.input("Weight")     # [k, dx, dy]
+    out = jnp.einsum("nd,kde,ne->nk", x, w, y)
+    bias = ctx.input("Bias")
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    ctx.set_output("Out", out)
+
+
+@register_op("conv_shift")
+def _conv_shift(ctx):
+    """Circular 1-D correlation (reference: conv_shift_op.cc): out[i,j] =
+    sum_k x[i, (j+k-m//2) mod n] * y[i,k] with y width m (odd)."""
+    x = ctx.input("X")  # [b, n]
+    y = ctx.input("Y")  # [b, m], m odd, m <= n
+    b, n = x.shape
+    m = y.shape[1]
+    half = m // 2
+    idx = (jnp.arange(n)[:, None] + jnp.arange(m)[None, :] - half) % n
+    ctx.set_output("Out", jnp.einsum("bnm,bm->bn", x[:, idx], y))
+
+
+@register_op("is_empty", no_grad_slots=["X"])
+def _is_empty(ctx):
+    import numpy as _np
+    x = ctx.input("X")
+    size = int(_np.prod(x.shape)) if x.shape else 0
+    ctx.set_output("Out", jnp.asarray(size == 0))

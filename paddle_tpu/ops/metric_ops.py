@@ -127,3 +127,118 @@ def _edit_distance(ctx):
         dist = dist / jnp.maximum(r.lengths.astype(jnp.float32), 1.0)
     ctx.set_output("Out", dist.reshape(-1, 1))
     ctx.set_output("SequenceNum", jnp.asarray(hd.shape[0], jnp.int64))
+
+
+@register_op("chunk_eval", no_grad_slots=["Inference", "Label"])
+def _chunk_eval(ctx):
+    """Chunking (NER-style) precision/recall/F1 over IOB-tagged ragged
+    sequences (reference: chunk_eval_op.cc). Tags encode
+    (chunk_type, tag_pos) as type * num_tag + pos with IOB pos: B=0, I=1.
+    A predicted chunk counts as correct when its begin, end, and type all
+    match a label chunk — computed here with a vectorized boundary match
+    instead of the reference's per-sequence C++ walk."""
+    inf = ctx.input("Inference")
+    lab = ctx.input("Label")
+    num_chunk_types = ctx.attr("num_chunk_types")
+    scheme = ctx.attr("chunk_scheme", "IOB")
+    if scheme != "IOB":
+        raise NotImplementedError("chunk_eval: only IOB scheme (the "
+                                  "reference default) is implemented")
+    from ..core.lod import RaggedPair as _RP
+    if isinstance(inf, _RP):
+        mask, inf, lab = inf.mask(), inf.data, lab.data
+    else:
+        mask = jnp.ones(inf.shape[:2], bool)
+    if inf.ndim == 3:
+        inf, lab = inf[..., 0], lab[..., 0]
+    num_tag = 2  # IOB: B, I
+
+    excluded = [int(t) for t in (ctx.attr("excluded_chunk_types") or [])]
+
+    def chunks(tags):
+        """begin/inside flags + chunk id per position."""
+        ctype = tags // num_tag
+        pos = tags % num_tag
+        outside = (tags < 0) | (tags >= num_chunk_types * num_tag)
+        for ex in excluded:  # excluded types count as outside
+            outside = outside | (ctype == ex)
+        prev_t = jnp.concatenate(
+            [jnp.full_like(ctype[:, :1], -1), ctype[:, :-1]], axis=1)
+        prev_out = jnp.concatenate(
+            [jnp.ones_like(outside[:, :1]), outside[:, :-1]], axis=1)
+        begin = ~outside & ((pos == 0) | prev_out | (ctype != prev_t))
+        return begin & mask, outside | ~mask, ctype
+
+    b_i, o_i, t_i = chunks(inf)
+    b_l, o_l, t_l = chunks(lab)
+    # chunk end at position k: in-chunk at k and (next is outside/begin/EOS)
+    def ends(begin, outside):
+        in_chunk = ~outside
+        nxt_boundary = jnp.concatenate(
+            [begin[:, 1:] | outside[:, 1:],
+             jnp.ones_like(begin[:, :1])], axis=1)
+        return in_chunk & nxt_boundary
+    e_i = ends(b_i, o_i)
+    e_l = ends(b_l, o_l)
+    # a chunk is a (begin position, end position, type); correct when all
+    # three coincide. Identify each chunk by its begin position: the end is
+    # the first end-flag at or after the begin. Compare via segment ids:
+    seg_i = jnp.cumsum(b_i.astype(jnp.int32), axis=1)
+    seg_l = jnp.cumsum(b_l.astype(jnp.int32), axis=1)
+    # positions agree on both segmentations and types and in/out status
+    agree = (b_i == b_l) & (e_i == e_l) & (o_i == o_l) & \
+        ((t_i == t_l) | o_i)
+    # a label chunk is correct if every position from its begin to its end
+    # agrees -> begin positions where cummin(agree) holds until end.
+    # Compute per position: "disagreement seen since chunk begin":
+    def correct_count(begin, end, outside):
+        # running flag reset at each begin
+        def step(carry, xs):
+            b, a = xs
+            ok = jnp.where(b, a, carry & a)
+            return ok, ok
+        agree_t = jnp.moveaxis(agree, 1, 0)
+        begin_t = jnp.moveaxis(begin, 1, 0)
+        _, ok_seq = jax.lax.scan(step, jnp.ones_like(agree[:, 0]),
+                                 (begin_t, agree_t))
+        ok_seq = jnp.moveaxis(ok_seq, 0, 1)
+        return jnp.sum((ok_seq & end & ~outside).astype(jnp.int64))
+    num_correct = correct_count(b_l, e_l, o_l)
+    num_inf = jnp.sum(b_i.astype(jnp.int64))
+    num_lab = jnp.sum(b_l.astype(jnp.int64))
+    precision = num_correct / jnp.maximum(num_inf, 1)
+    recall = num_correct / jnp.maximum(num_lab, 1)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    ctx.set_output("Precision", precision.astype(jnp.float32))
+    ctx.set_output("Recall", recall.astype(jnp.float32))
+    ctx.set_output("F1-Score", f1.astype(jnp.float32))
+    ctx.set_output("NumInferChunks", num_inf)
+    ctx.set_output("NumLabelChunks", num_lab)
+    ctx.set_output("NumCorrectChunks", num_correct)
+
+
+@register_op("positive_negative_pair", no_grad_slots=["Score", "Label",
+                                                      "QueryID"])
+def _positive_negative_pair(ctx):
+    """Ranking pair statistics (reference: positive_negative_pair_op.cc):
+    within each query, count (pos, neg) item pairs ordered correctly /
+    incorrectly / tied by score."""
+    score = ctx.input("Score").reshape(-1)
+    label = ctx.input("Label").reshape(-1)
+    qid = ctx.input("QueryID").reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    higher_label = label[:, None] > label[None, :]
+    pair = same_q & higher_label          # (i better than j) pairs
+    s_i = score[:, None]
+    s_j = score[None, :]
+    pos = jnp.sum((pair & (s_i > s_j)).astype(jnp.float32))
+    neg = jnp.sum((pair & (s_i < s_j)).astype(jnp.float32))
+    neu = jnp.sum((pair & (s_i == s_j)).astype(jnp.float32))
+    acc_pos = ctx.input("AccumulatePositivePair")
+    acc_neg = ctx.input("AccumulateNegativePair")
+    acc_neu = ctx.input("AccumulateNeutralPair")
+    if acc_pos is not None:
+        pos, neg, neu = pos + acc_pos, neg + acc_neg, neu + acc_neu
+    ctx.set_output("PositivePair", pos.reshape(1))
+    ctx.set_output("NegativePair", neg.reshape(1))
+    ctx.set_output("NeutralPair", neu.reshape(1))

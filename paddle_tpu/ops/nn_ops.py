@@ -65,6 +65,23 @@ def _depthwise_conv2d(ctx):
     ctx.set_output("Output", out)
 
 
+def _conv_transpose_impl(x, w, s, p, d, nd):
+    """Transposed conv as an input-dilated conv with a flipped, IO-swapped
+    kernel — the gradient-of-conv identity, so output size is the
+    reference's (i-1)*stride - 2*pad + dilation*(k-1) + 1
+    (conv_transpose_op.cc). w: [in_c, out_c, *k]."""
+    wk = jnp.flip(w, axis=tuple(range(2, 2 + nd))).swapaxes(0, 1)
+    pad = [(d[i] * (w.shape[2 + i] - 1) - p[i],) * 2 for i in range(nd)]
+    dn = (("NCHW", "OIHW", "NCHW") if nd == 2
+          else ("NCDHW", "OIDHW", "NCDHW"))
+    out_dtype = x.dtype
+    x, wk = amp_cast(x, wk)
+    return jax.lax.conv_general_dilated(
+        x, wk, window_strides=(1,) * nd, padding=pad,
+        lhs_dilation=s, rhs_dilation=d,
+        dimension_numbers=dn).astype(out_dtype)
+
+
 @register_op("conv2d_transpose")
 def _conv2d_transpose(ctx):
     x = ctx.input("Input")
@@ -72,13 +89,7 @@ def _conv2d_transpose(ctx):
     s = _pair(ctx.attr("strides", [1, 1]))
     p = _pair(ctx.attr("paddings", [0, 0]))
     d = _pair(ctx.attr("dilations", [1, 1]))
-    out = jax.lax.conv_transpose(
-        x, w, strides=s,
-        padding=[(p[0], p[0]), (p[1], p[1])],
-        rhs_dilation=d,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=True)
-    ctx.set_output("Output", out)
+    ctx.set_output("Output", _conv_transpose_impl(x, w, s, p, d, 2))
 
 
 @register_op("conv3d")
@@ -519,3 +530,218 @@ def _im2sequence(ctx):
         dimension_numbers=("NCHW", "OIHW", "NCHW"))  # [n, c*kh*kw, oh, ow]
     out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
     ctx.set_output("Out", out)
+
+
+# -- remaining pool/conv surface (reference: pool_op.cc 3D variants,
+# pool_with_index_op.cc, unpool_op.cc, spp_op.cc, roi_pool_op.cc,
+# conv_transpose_op.cc 3D) --------------------------------------------------
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+@register_op("pool3d")
+def _pool3d(ctx):
+    x = ctx.input("X")  # NCDHW
+    ptype = ctx.attr("pooling_type", "max")
+    k = _triple(ctx.attr("ksize", [2, 2, 2]))
+    s = _triple(ctx.attr("strides", [2, 2, 2]))
+    p = _triple(ctx.attr("paddings", [0, 0, 0]))
+    if ctx.attr("global_pooling", False):
+        k = tuple(x.shape[2:5])
+        s = k
+        p = (0, 0, 0)
+    dims = (1, 1) + k
+    strides = (1, 1) + s
+    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    if ptype == "max":
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims, strides,
+                                    pads)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides,
+                                       pads)
+        if ctx.attr("exclusive", True) and any(p):
+            counts = jax.lax.reduce_window(jnp.ones_like(x), 0.0,
+                                           jax.lax.add, dims, strides, pads)
+            out = summed / counts
+        else:
+            out = summed / (k[0] * k[1] * k[2])
+    ctx.set_output("Out", out)
+
+
+def _pool_with_index(x, k, s, p):
+    """Max pool + flat argmax index per window via conv patches
+    (TPU-friendly: one gather-free argmax over the window axis)."""
+    n, c, h, w = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s,
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    oh, ow = patches.shape[2], patches.shape[3]
+    patches = patches.reshape(n, c, k[0] * k[1], oh, ow)
+    # positions of each window element in the (padded) input
+    ky, kx = jnp.meshgrid(jnp.arange(k[0]), jnp.arange(k[1]), indexing="ij")
+    ky, kx = ky.reshape(-1), kx.reshape(-1)               # [K]
+    oy = jnp.arange(oh) * s[0] - p[0]                     # [oh]
+    ox = jnp.arange(ow) * s[1] - p[1]                     # [ow]
+    rows = oy[None, :] + ky[:, None]                      # [K, oh]
+    cols = ox[None, :] + kx[:, None]                      # [K, ow]
+    valid = ((rows >= 0) & (rows < h))[:, :, None] & \
+            ((cols >= 0) & (cols < w))[:, None, :]        # [K, oh, ow]
+    patches = jnp.where(valid[None, None], patches, -jnp.inf)
+    widx = jnp.argmax(patches, axis=2)                    # [n, c, oh, ow]
+    out = jnp.max(patches, axis=2)
+    flat = rows[:, :, None] * w + cols[:, None, :]        # [K, oh, ow]
+    index = jnp.take_along_axis(
+        jnp.broadcast_to(flat[None, None], (n, c) + flat.shape),
+        widx[:, :, None], axis=2).squeeze(2)
+    return out, index.astype(jnp.int32)
+
+
+@register_op("max_pool2d_with_index")
+def _max_pool2d_with_index(ctx):
+    x = ctx.input("X")
+    k = _pair(ctx.attr("ksize", [2, 2]))
+    s = _pair(ctx.attr("strides", [2, 2]))
+    p = _pair(ctx.attr("paddings", [0, 0]))
+    if ctx.attr("global_pooling", False):
+        k, s, p = (x.shape[2], x.shape[3]), (x.shape[2], x.shape[3]), (0, 0)
+    out, index = _pool_with_index(x, k, s, p)
+    ctx.set_output("Out", out)
+    ctx.set_output("Mask", index)
+
+
+@register_op("max_pool3d_with_index")
+def _max_pool3d_with_index(ctx):
+    """3-D variant: loop the 2-D patch trick over depth slices of the
+    pooling window (D is small: the kernel depth)."""
+    x = ctx.input("X")  # NCDHW
+    k = _triple(ctx.attr("ksize", [2, 2, 2]))
+    s = _triple(ctx.attr("strides", [2, 2, 2]))
+    p = _triple(ctx.attr("paddings", [0, 0, 0]))
+    if ctx.attr("global_pooling", False):
+        k = tuple(x.shape[2:5]); s = k; p = (0, 0, 0)
+    n, c, d, h, w = x.shape
+    od = (d + 2 * p[0] - k[0]) // s[0] + 1
+    best_val, best_idx = None, None
+    for kd in range(k[0]):
+        zs = jnp.arange(od) * s[0] - p[0] + kd          # depth slice per od
+        valid = (zs >= 0) & (zs < d)
+        sl = x[:, :, jnp.clip(zs, 0, d - 1)]             # [n, c, od, h, w]
+        sl = jnp.where(valid[None, None, :, None, None], sl, -jnp.inf)
+        # apply 2-D pooling per depth slice by folding od into batch
+        v2f = sl.transpose(0, 2, 1, 3, 4).reshape(n * od, c, h, w)
+        out2, idx2 = _pool_with_index(v2f, k[1:], s[1:], p[1:])
+        oh, ow = out2.shape[2], out2.shape[3]
+        out2 = out2.reshape(n, od, c, oh, ow).transpose(0, 2, 1, 3, 4)
+        idx2 = idx2.reshape(n, od, c, oh, ow).transpose(0, 2, 1, 3, 4)
+        flat = jnp.clip(zs, 0, d - 1)[None, None, :, None, None] * (h * w) \
+            + idx2
+        if best_val is None:
+            best_val, best_idx = out2, flat
+        else:
+            take = out2 > best_val
+            best_val = jnp.where(take, out2, best_val)
+            best_idx = jnp.where(take, flat, best_idx)
+    ctx.set_output("Out", best_val)
+    ctx.set_output("Mask", best_idx.astype(jnp.int32))
+
+
+@register_op("unpool", no_grad_slots=["Indices"])
+def _unpool(ctx):
+    """Max-unpool with indices from max_pool2d_with_index (reference:
+    unpool_op.cc): scatter pooled values back to their argmax positions."""
+    x = ctx.input("X")            # [n, c, oh, ow]
+    indices = ctx.input("Indices")
+    oh_ow = ctx.attr("unpool_size", None)
+    if oh_ow is None:
+        ksize = _pair(ctx.attr("ksize", [2, 2]))
+        strides = _pair(ctx.attr("strides", ksize))
+        h = (x.shape[2] - 1) * strides[0] + ksize[0]
+        w = (x.shape[3] - 1) * strides[1] + ksize[1]
+    else:
+        h, w = _pair(oh_ow)
+    n, c = x.shape[0], x.shape[1]
+    flat = jnp.zeros((n, c, h * w), x.dtype)
+    idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    vals = x.reshape(n, c, -1)
+    flat = flat.at[jnp.arange(n)[:, None, None],
+                   jnp.arange(c)[None, :, None], idx].set(vals)
+    ctx.set_output("Out", flat.reshape(n, c, h, w))
+
+
+@register_op("spp")
+def _spp(ctx):
+    """Spatial pyramid pooling (reference: spp_op.cc): concat flattened
+    adaptive pools at 1x1, 2x2, ... 2^(L-1) bins."""
+    x = ctx.input("X")
+    levels = ctx.attr("pyramid_height", 3)
+    ptype = ctx.attr("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for lv in range(levels):
+        bins = 2 ** lv
+        kh, kw = -(-h // bins), -(-w // bins)  # ceil
+        sh, sw = kh, kw
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        dims, strides = (1, 1, kh, kw), (1, 1, sh, sw)
+        pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+        if ptype == "max":
+            o = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims,
+                                      strides, pads)
+        else:
+            o = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides,
+                                      pads) / (kh * kw)
+        outs.append(o[:, :, :bins, :bins].reshape(n, -1))
+    ctx.set_output("Out", jnp.concatenate(outs, axis=1))
+
+
+@register_op("roi_pool", no_grad_slots=["ROIs"])
+def _roi_pool(ctx):
+    """Max pooling over regions of interest (reference: roi_pool_op.cc).
+    ROIs: [R, 5] = (batch_idx, x1, y1, x2, y2) in input scale; static
+    output [R, C, PH, PW] via per-bin masked max (TPU: no dynamic shapes)."""
+    x = ctx.input("X")            # [n, c, h, w]
+    rois = ctx.input("ROIs")      # [R, 5] float
+    ph = ctx.attr("pooled_height", 1)
+    pw = ctx.attr("pooled_width", 1)
+    scale = ctx.attr("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = x[b]                                    # [c, h, w]
+        ys = jnp.arange(h)[None, :]                   # [1, h]
+        xs = jnp.arange(w)[None, :]                   # [1, w]
+        binh = rh / ph
+        binw = rw / pw
+        hs = jnp.floor(y1 + jnp.arange(ph)[:, None] * binh).astype(jnp.int32)
+        he = jnp.ceil(y1 + (jnp.arange(ph)[:, None] + 1) * binh).astype(jnp.int32)
+        ws_ = jnp.floor(x1 + jnp.arange(pw)[:, None] * binw).astype(jnp.int32)
+        we = jnp.ceil(x1 + (jnp.arange(pw)[:, None] + 1) * binw).astype(jnp.int32)
+        mh = (ys >= hs) & (ys < he) & (ys >= 0) & (ys < h)   # [ph, h]
+        mw = (xs >= ws_) & (xs < we) & (xs >= 0) & (xs < w)  # [pw, w]
+        m = mh[:, None, :, None] & mw[None, :, None, :]      # [ph, pw, h, w]
+        masked = jnp.where(m[None], img[:, None, None], -jnp.inf)
+        out = masked.max(axis=(-1, -2))                      # [c, ph, pw]
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    ctx.set_output("Out", jax.vmap(one_roi)(rois.astype(jnp.float32)))
+
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(ctx):
+    x = ctx.input("Input")
+    w = ctx.input("Filter")  # [in_c, out_c, kd, kh, kw]
+    s = _triple(ctx.attr("strides", [1, 1, 1]))
+    p = _triple(ctx.attr("paddings", [0, 0, 0]))
+    d = _triple(ctx.attr("dilations", [1, 1, 1]))
+    ctx.set_output("Output", _conv_transpose_impl(x, w, s, p, d, 3))

@@ -188,3 +188,44 @@ def _lars_momentum(ctx):
     v_out = mu * v + local_lr * (g + decay * p)
     ctx.set_output("ParamOut", p - v_out)
     ctx.set_output("VelocityOut", v_out)
+
+
+@register_op("proximal_gd", no_grad_slots=["Param", "Grad", "LearningRate"])
+def _proximal_gd(ctx):
+    """Proximal gradient descent with L1/L2 regularization (reference:
+    proximal_gd_op.cc): prox_param = param - lr*grad, then soft-threshold."""
+    p = ctx.input("Param")
+    g = ctx.input("Grad")
+    lr = ctx.input("LearningRate").reshape(())
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    prox = p - lr * g
+    if l1 > 0:
+        out = (jnp.sign(prox) *
+               jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)) / (1.0 + lr * l2)
+    else:
+        out = prox / (1.0 + lr * l2)
+    ctx.set_output("ParamOut", out)
+
+
+@register_op("proximal_adagrad", no_grad_slots=["Param", "Grad", "Moment",
+                                                "LearningRate"])
+def _proximal_adagrad(ctx):
+    """Proximal Adagrad (reference: proximal_adagrad_op.cc)."""
+    p = ctx.input("Param")
+    g = ctx.input("Grad")
+    m = ctx.input("Moment")
+    lr = ctx.input("LearningRate").reshape(())
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    m_out = m + g * g
+    eff_lr = lr / jnp.sqrt(m_out)
+    prox = p - eff_lr * g
+    if l1 > 0:
+        out = (jnp.sign(prox) *
+               jnp.maximum(jnp.abs(prox) - eff_lr * l1, 0.0)) / \
+            (1.0 + eff_lr * l2)
+    else:
+        out = prox / (1.0 + eff_lr * l2)
+    ctx.set_output("ParamOut", out)
+    ctx.set_output("MomentOut", m_out)

@@ -215,13 +215,24 @@ def _masked_scan_rnn(step, xs, init_states, lengths):
     def body(carry, inp):
         t, x_t = inp
         new_carry, out_t = step(carry, x_t)
-        alive = (t < lengths).reshape((-1,) + (1,) * (out_t.ndim - 1))
-        sel = lambda n, o: jnp.where(alive, n, o)
+        is_tuple = isinstance(out_t, tuple)
+        outs = out_t if is_tuple else (out_t,)
+        alive0 = (t < lengths)
+
+        def mask(o):
+            a = alive0.reshape((-1,) + (1,) * (o.ndim - 1))
+            return o * a.astype(o.dtype)
+
+        sel = lambda n, o: jnp.where(
+            alive0.reshape((-1,) + (1,) * (n.ndim - 1)), n, o)
         carry = tuple(sel(n, o) for n, o in zip(new_carry, carry))
-        return carry, out_t * alive.astype(out_t.dtype)
+        masked = tuple(mask(o) for o in outs)
+        return carry, (masked if is_tuple else masked[0])
 
     xs_t = jnp.moveaxis(xs, 1, 0)  # [t, n, ...]
     carry, outs = jax.lax.scan(body, init_states, (tpos, xs_t))
+    if isinstance(outs, tuple):
+        return carry, tuple(jnp.moveaxis(o, 0, 1) for o in outs)
     return carry, jnp.moveaxis(outs, 0, 1)
 
 
@@ -260,27 +271,43 @@ def _lstm(ctx):
     h0 = h0 if h0 is not None else jnp.zeros((n, h_dim), data.dtype)
     c0 = c0 if c0 is not None else jnp.zeros((n, h_dim), data.dtype)
 
+    # Peephole weights: reference packs them in Bias as [1, 7h] when
+    # use_peepholes (lstm_op.cc: W_ic, W_fc, W_oc after the 4h gate bias).
+    use_peepholes = ctx.attr("use_peepholes", False) and b is not None \
+        and b.reshape(-1).shape[0] >= 7 * h_dim
+    if use_peepholes:
+        bflat = b.reshape(-1)
+        w_ic = bflat[4 * h_dim:5 * h_dim].reshape(1, -1)
+        w_fc = bflat[5 * h_dim:6 * h_dim].reshape(1, -1)
+        w_oc = bflat[6 * h_dim:7 * h_dim].reshape(1, -1)
+
     def step(carry, x_t):
         h_prev, c_prev = carry
         gates = x_t + h_prev @ w
         if b is not None:
             gates = gates + b.reshape(1, -1)[:, :4 * h_dim]
         i, c_hat, f, o = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            i = i + w_ic * c_prev
+            f = f + w_fc * c_prev
         i = gate_act(i)
         f = gate_act(f)
-        o = gate_act(o)
         c = f * c_prev + i * cand_act(c_hat)
+        if use_peepholes:
+            o = o + w_oc * c
+        o = gate_act(o)
         h = o * cell_act(c)
-        return (h, c), h
+        return (h, c), (h, c)
 
-    (h_last, c_last), hidden = _masked_scan_rnn(step, data, (h0, c0),
-                                                x.lengths)
+    (h_last, c_last), (hidden, cells) = _masked_scan_rnn(
+        step, data, (h0, c0), x.lengths)
     if is_reverse:
         t = hidden.shape[1]
         idx = (x.lengths[:, None] - 1 - jnp.arange(t)[None, :]) % t
         hidden = jnp.take_along_axis(hidden, idx[..., None], axis=1)
+        cells = jnp.take_along_axis(cells, idx[..., None], axis=1)
     ctx.set_output("Hidden", RaggedPair(hidden, x.lengths))
-    ctx.set_output("Cell", RaggedPair(jnp.zeros_like(hidden), x.lengths))
+    ctx.set_output("Cell", RaggedPair(cells, x.lengths))
     ctx.set_output("LastH", h_last)
     ctx.set_output("LastC", c_last)
 
@@ -359,3 +386,196 @@ def _sequence_last_step(ctx):
 def _sequence_first_step(ctx):
     x = _as_ragged(ctx.input("X"))
     ctx.set_output("Out", x.data[:, 0])
+
+
+# -- CTC (reference: warpctc_op.cc wraps the warp-ctc CUDA lib;
+# ctc_align_op.cc / Python ctc_greedy_decoder) ------------------------------
+
+NEG_INF = -1e30
+
+
+def _ctc_loss_single_batch(logits, logit_lens, labels, label_lens, blank):
+    """CTC negative log-likelihood via the standard alpha recursion in log
+    space, vectorized over the batch and scanned over time — one fused XLA
+    loop instead of the reference's per-sample CUDA kernels.
+
+    logits: [B, T, C] raw (pre-softmax); labels: int32 [B, L] padded.
+    """
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    B, T, C = logp.shape
+    L = labels.shape[1]
+    U = 2 * L + 1
+
+    # Extended label sequence with interleaved blanks: [B, U]
+    ext = jnp.full((B, U), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels.astype(jnp.int32))
+    # allow the s-2 skip where ext[s] is a real label != ext[s-2]
+    skip_ok = jnp.zeros((B, U), bool)
+    skip_ok = skip_ok.at[:, 2:].set(
+        (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]))
+
+    # states beyond 2*label_len are invalid
+    spos = jnp.arange(U)[None, :]
+    state_valid = spos <= 2 * label_lens[:, None]
+
+    emit0 = jnp.take_along_axis(logp[:, 0], ext, axis=1)  # [B, U]
+    alpha0 = jnp.where((spos <= 1) & state_valid, emit0, NEG_INF)
+
+    def step(alpha, t):
+        emit = jnp.take_along_axis(logp[:, t], ext, axis=1)
+        stay = alpha
+        prev1 = jnp.concatenate(
+            [jnp.full((B, 1), NEG_INF), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate(
+            [jnp.full((B, 2), NEG_INF), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(skip_ok, prev2, NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2) + emit
+        merged = jnp.where(state_valid, merged, NEG_INF)
+        # frozen past each sequence's end: carry alpha unchanged
+        alive = (t < logit_lens)[:, None]
+        return jnp.where(alive, merged, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    end1 = 2 * label_lens          # final blank state
+    end2 = jnp.maximum(2 * label_lens - 1, 0)  # final label state
+    a1 = jnp.take_along_axis(alpha, end1[:, None], axis=1)[:, 0]
+    a2 = jnp.take_along_axis(alpha, end2[:, None], axis=1)[:, 0]
+    a2 = jnp.where(label_lens > 0, a2, NEG_INF)
+    return -jnp.logaddexp(a1, a2)
+
+
+@register_op_SEQ("warpctc", no_grad_slots=["Label"])
+def _warpctc(ctx):
+    """CTC loss over ragged logits/labels (reference: warpctc_op.cc).
+    Gradients flow through the scan via autodiff — exact, unlike the
+    reference's hand-written backward."""
+    logits = _as_ragged(ctx.input("Logits"))
+    label = _as_ragged(ctx.input("Label"))
+    blank = ctx.attr("blank", 0)
+    norm_by_times = ctx.attr("norm_by_times", False)
+    lab = label.data
+    if lab.ndim == 3 and lab.shape[-1] == 1:
+        lab = lab[..., 0]
+    nll = _ctc_loss_single_batch(logits.data, logits.lengths, lab,
+                                 label.lengths, blank)
+    if norm_by_times:
+        nll = nll / jnp.maximum(logits.lengths, 1).astype(nll.dtype)
+    ctx.set_output("Loss", nll[:, None].astype(logits.data.dtype))
+
+
+@register_op_SEQ("ctc_greedy_decoder", no_grad_slots=["Input"])
+def _ctc_greedy_decoder(ctx):
+    """Best-path decode: argmax per frame, merge repeats, drop blanks
+    (reference: Python ctc_greedy_decoder + ctc_align_op.cc). Static-shape
+    compaction via cumsum positions + scatter."""
+    x = _as_ragged(ctx.input("Input"))  # [B, T, C] probs or logits
+    blank = ctx.attr("blank", 0)
+    best = jnp.argmax(x.data, axis=-1).astype(jnp.int32)   # [B, T]
+    B, T = best.shape
+    mask = x.mask()
+    prev = jnp.concatenate([jnp.full((B, 1), -1, jnp.int32),
+                            best[:, :-1]], axis=1)
+    keep = (best != blank) & (best != prev) & mask
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1   # target slot
+    out_lens = keep.astype(jnp.int32).sum(axis=1)
+    # scatter kept tokens into a [B, T] buffer (padded with zeros)
+    buf = jnp.zeros((B, T + 1), jnp.int32)
+    scatter_pos = jnp.where(keep, pos, T)                  # T = trash slot
+    buf = buf.at[jnp.arange(B)[:, None], scatter_pos].set(best)
+    ctx.set_output("Out", RaggedPair(buf[:, :T, None], out_lens))
+
+
+# -- single-step RNN cells (reference: lstm_unit_op.cc, gru_unit_op.cc,
+# lstmp_op.cc) --------------------------------------------------------------
+
+@register_op("lstm_unit")
+def _lstm_unit(ctx):
+    """One LSTM step on pre-projected gates (reference: lstm_unit_op.cc).
+    X: [n, 4d] packed i,f,o,g? — the reference packs i, g(c_hat), f, o as
+    in lstm_op; C_prev: [n, d]. forget_bias added to f pre-sigmoid."""
+    x = ctx.input("X")
+    c_prev = ctx.input("C_prev")
+    fb = ctx.attr("forget_bias", 0.0)
+    d = c_prev.shape[-1]
+    i, g, f, o = (x[:, :d], x[:, d:2 * d], x[:, 2 * d:3 * d], x[:, 3 * d:])
+    c = jax.nn.sigmoid(f + fb) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    ctx.set_output("C", c)
+    ctx.set_output("H", h)
+
+
+@register_op("gru_unit")
+def _gru_unit(ctx):
+    """One GRU step (reference: gru_unit_op.cc). Input: [n, 3d] projected
+    x contributions; HiddenPrev [n, d]; Weight [d, 3d]; Bias [1, 3d]."""
+    x = ctx.input("Input")
+    h_prev = ctx.input("HiddenPrev")
+    w = ctx.input("Weight")
+    b = ctx.input("Bias")
+    d = h_prev.shape[-1]
+    if b is not None:
+        x = x + b.reshape(1, -1)
+    xu, xr, xc = x[:, :d], x[:, d:2 * d], x[:, 2 * d:]
+    hu = h_prev @ w[:, :d]
+    hr = h_prev @ w[:, d:2 * d]
+    u = jax.nn.sigmoid(xu + hu)
+    r = jax.nn.sigmoid(xr + hr)
+    c = jnp.tanh(xc + (r * h_prev) @ w[:, 2 * d:])
+    h = u * h_prev + (1.0 - u) * c
+    ctx.set_output("Gate", jnp.concatenate([u, r, c], axis=-1))
+    ctx.set_output("ResetHiddenPrev", r * h_prev)
+    ctx.set_output("Hidden", h)
+
+
+@register_op_SEQ("lstmp")
+def _lstmp(ctx):
+    """LSTM with recurrent projection (reference: lstmp_op.cc): cell size
+    d, projected hidden size p; recurrence runs on the projection."""
+    x = _as_ragged(ctx.input("Input"))       # [n, t, 4d] pre-projected
+    w = ctx.input("Weight")                  # [p, 4d]
+    w_proj = ctx.input("ProjWeight")         # [d, p]
+    b = ctx.input("Bias")
+    d = w_proj.shape[0]
+    p = w_proj.shape[1]
+    n = x.data.shape[0]
+    gate_act = _ACT[ctx.attr("gate_activation", "sigmoid")]
+    cell_act = _ACT[ctx.attr("cell_activation", "tanh")]
+    cand_act = _ACT[ctx.attr("candidate_activation", "tanh")]
+    proj_act = _ACT[ctx.attr("proj_activation", "tanh")]
+
+    h0 = ctx.input("H0")
+    c0 = ctx.input("C0")
+    r0 = jnp.zeros((n, p), x.data.dtype) if h0 is None else h0 @ w_proj \
+        if h0.shape[-1] == d else h0
+    c0 = c0 if c0 is not None else jnp.zeros((n, d), x.data.dtype)
+
+    use_peepholes = ctx.attr("use_peepholes", False) and b is not None \
+        and b.reshape(-1).shape[0] >= 7 * d
+    if use_peepholes:
+        bflat = b.reshape(-1)
+        w_ic = bflat[4 * d:5 * d].reshape(1, -1)
+        w_fc = bflat[5 * d:6 * d].reshape(1, -1)
+        w_oc = bflat[6 * d:7 * d].reshape(1, -1)
+
+    def step(carry, x_t):
+        r_prev, c_prev = carry
+        gates = x_t + r_prev @ w
+        if b is not None:
+            gates = gates + b.reshape(1, -1)[:, :4 * d]
+        i, c_hat, f, o = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            i = i + w_ic * c_prev
+            f = f + w_fc * c_prev
+        c = gate_act(f) * c_prev + gate_act(i) * cand_act(c_hat)
+        if use_peepholes:
+            o = o + w_oc * c
+        h = gate_act(o) * cell_act(c)
+        r = proj_act(h @ w_proj)
+        return (r, c), (r, c)
+
+    (r_last, c_last), (proj, cells) = _masked_scan_rnn(
+        step, x.data, (r0, c0), x.lengths)
+    ctx.set_output("Projection", RaggedPair(proj, x.lengths))
+    ctx.set_output("Cell", RaggedPair(cells, x.lengths))
+    ctx.set_output("LastH", r_last)
+    ctx.set_output("LastC", c_last)

@@ -265,3 +265,13 @@ def _diag(ctx):
 def _eye(ctx):
     ctx.set_output("Out", jnp.eye(ctx.attr("num_rows"),
                                   ctx.attr("num_columns")))
+
+
+@register_op("multiplex", no_grad_slots=["Ids"])
+def _multiplex(ctx):
+    """Row-wise select among candidate tensors by index (reference:
+    multiplex_op.cc): Out[i] = X[Ids[i]][i]."""
+    ids = ctx.input("Ids").reshape(-1).astype(jnp.int32)
+    xs = jnp.stack(ctx.inputs("X"), axis=0)      # [k, n, ...]
+    n = xs.shape[1]
+    ctx.set_output("Out", xs[ids, jnp.arange(n)])

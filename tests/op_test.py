@@ -103,6 +103,10 @@ class OpTestHarness:
     def outputs(self) -> Dict[str, np.ndarray]:
         return {s: _dense(o) for s, o in self._run_forward().items()}
 
+    def run_forward(self) -> Dict[str, object]:
+        """Raw fetched outputs (dense numpy, or LoDTensor for ragged)."""
+        return self._run_forward()
+
     def _in_graph_out_shape(self, slot: str):
         """Shape of the op's output as the graph sees it: ragged (lod)
         fetches come back as flat LoDTensors, but in-graph they are

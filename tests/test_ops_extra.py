@@ -1,0 +1,417 @@
+"""NumPy-oracle checks for the op-surface completion batch: remaining
+activations, losses, pooling variants (with-index / unpool / spp / roi),
+CTC (warpctc + greedy decode), single-step RNN cells, chunk_eval,
+positive_negative_pair, proximal optimizers.
+
+Reference parity targets: activation_op.cc, modified_huber_loss_op.cc,
+rank_loss_op.cc, pool_with_index_op.cc, unpool_op.cc, spp_op.cc,
+roi_pool_op.cc, warpctc_op.cc, gru_unit_op.cc, lstm_unit_op.cc,
+chunk_eval_op.cc, positive_negative_pair_op.cc, proximal_*_op.cc.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.core.lod import RaggedPair
+from op_test import OpTestHarness
+
+
+def _r(shape, seed=0, scale=1.0):
+    return (np.random.RandomState(seed).uniform(-1, 1, shape) * scale
+            ).astype(np.float32)
+
+
+# -- activations ------------------------------------------------------------
+
+def test_brelu_softshrink_hardshrink_thresholded_stanh():
+    x = _r((3, 5), 1, 3.0)
+    t = OpTestHarness("brelu", {"X": ("x", x)},
+                      attrs={"t_min": -1.0, "t_max": 1.0})
+    t.check_output({"Out": np.clip(x, -1.0, 1.0)})
+    t = OpTestHarness("softshrink", {"X": ("x", x)}, attrs={"lambda": 0.5})
+    t.check_output({"Out": np.where(x > .5, x - .5,
+                                    np.where(x < -.5, x + .5, 0))})
+    t = OpTestHarness("hard_shrink", {"X": ("x", x)},
+                      attrs={"threshold": 0.5})
+    t.check_output({"Out": np.where(np.abs(x) > .5, x, 0)})
+    t = OpTestHarness("thresholded_relu", {"X": ("x", x)},
+                      attrs={"threshold": 0.3})
+    t.check_output({"Out": np.where(x > .3, x, 0)})
+    t = OpTestHarness("stanh", {"X": ("x", x)})
+    t.check_output({"Out": 1.7159 * np.tanh(0.66667 * x)}, atol=1e-5)
+
+
+def test_prelu():
+    x = _r((4, 3, 2, 2), 2)
+    x = x + np.sign(x) * 0.05  # keep |x| > finite-difference eps (kink at 0)
+    alpha = np.asarray([0.1, 0.2, 0.3], np.float32)
+    t = OpTestHarness("prelu", {"X": ("x", x), "Alpha": ("a", alpha)},
+                      attrs={"mode": "channel"})
+    ref = np.where(x > 0, x, alpha.reshape(1, 3, 1, 1) * x)
+    t.check_output({"Out": ref})
+    t.check_grad(["x", "a"], eps=1e-3, max_relative_error=2e-2)
+
+
+def test_label_smooth():
+    x = np.eye(4, dtype=np.float32)[None].repeat(2, 0).reshape(8, 4)
+    t = OpTestHarness("label_smooth", {"X": ("x", x)},
+                      attrs={"epsilon": 0.1})
+    t.check_output({"Out": 0.9 * x + 0.1 / 4})
+
+
+# -- losses -----------------------------------------------------------------
+
+def test_modified_huber_loss():
+    x = _r((6, 1), 3, 2.0)
+    y = (np.random.RandomState(4).rand(6, 1) > 0.5).astype(np.float32)
+    t = OpTestHarness("modified_huber_loss", {"X": ("x", x), "Y": ("y", y)},
+                      out_slots=["Out"])
+    yv = (2 * y - 1) * x
+    ref = np.where(yv < -1, -4 * yv, np.square(np.maximum(0, 1 - yv)))
+    t.check_output({"Out": ref.astype(np.float32)})
+
+
+def test_rank_loss():
+    lab = (np.random.RandomState(5).rand(5, 1) > 0.5).astype(np.float32)
+    left, right = _r((5, 1), 6), _r((5, 1), 7)
+    t = OpTestHarness("rank_loss", {"Label": ("lab", lab),
+                                    "Left": ("l", left),
+                                    "Right": ("r", right)})
+    d = left - right
+    t.check_output({"Out": (-lab * d + np.log1p(np.exp(d))).astype(np.float32)},
+                   atol=1e-5)
+    t.check_grad(["l", "r"], eps=1e-3, max_relative_error=2e-2)
+
+
+def test_squared_l2_distance_and_l1_norm():
+    x, y = _r((4, 6), 8), _r((4, 6), 9)
+    t = OpTestHarness("squared_l2_distance", {"X": ("x", x), "Y": ("y", y)})
+    t.check_output({"Out": np.square(x - y).sum(-1, keepdims=True)},
+                   atol=1e-5)
+    t = OpTestHarness("l1_norm", {"X": ("x", x)})
+    t.check_output({"Out": np.abs(x).sum()}, atol=1e-5)
+
+
+def test_norm_op():
+    x = _r((2, 3, 4), 10)
+    scale = np.asarray([1.0, 2.0, 0.5], np.float32)
+    t = OpTestHarness("norm", {"X": ("x", x), "Scale": ("s", scale)})
+    n = np.sqrt((x ** 2).sum(1, keepdims=True) + 1e-10)
+    t.check_output({"Out": scale.reshape(1, 3, 1) * x / n}, atol=1e-5)
+
+
+def test_bilinear_tensor_product():
+    x, y = _r((3, 4), 11), _r((3, 5), 12)
+    w = _r((2, 4, 5), 13)
+    t = OpTestHarness("bilinear_tensor_product",
+                      {"X": ("x", x), "Y": ("y", y), "Weight": ("w", w)})
+    ref = np.einsum("nd,kde,ne->nk", x, w, y)
+    t.check_output({"Out": ref.astype(np.float32)}, atol=1e-5)
+    t.check_grad(["x", "y", "w"], eps=1e-3, max_relative_error=2e-2)
+
+
+def test_conv_shift():
+    x = _r((2, 6), 14)
+    y = _r((2, 3), 15)
+    t = OpTestHarness("conv_shift", {"X": ("x", x), "Y": ("y", y)})
+    b, n = x.shape
+    m = y.shape[1]
+    ref = np.zeros_like(x)
+    for bi in range(b):
+        for j in range(n):
+            for k in range(m):
+                ref[bi, j] += x[bi, (j + k - m // 2) % n] * y[bi, k]
+    t.check_output({"Out": ref}, atol=1e-5)
+
+
+# -- pooling variants -------------------------------------------------------
+
+def _np_max_pool_with_index(x, k, s, p):
+    n, c, h, w = x.shape
+    oh = (h + 2 * p[0] - k[0]) // s[0] + 1
+    ow = (w + 2 * p[1] - k[1]) // s[1] + 1
+    out = np.zeros((n, c, oh, ow), x.dtype)
+    idx = np.zeros((n, c, oh, ow), np.int32)
+    for i in range(oh):
+        for j in range(ow):
+            best = -np.inf * np.ones((n, c), x.dtype)
+            bidx = np.zeros((n, c), np.int32)
+            for ky in range(k[0]):
+                for kx in range(k[1]):
+                    y_, x_ = i * s[0] - p[0] + ky, j * s[1] - p[1] + kx
+                    if not (0 <= y_ < h and 0 <= x_ < w):
+                        continue
+                    v = x[:, :, y_, x_]
+                    take = v > best
+                    best = np.where(take, v, best)
+                    bidx = np.where(take, y_ * w + x_, bidx)
+            out[:, :, i, j] = best
+            idx[:, :, i, j] = bidx
+    return out, idx
+
+
+def test_max_pool2d_with_index():
+    x = _r((2, 3, 6, 6), 16)
+    out, idx = _np_max_pool_with_index(x, (2, 2), (2, 2), (0, 0))
+    t = OpTestHarness("max_pool2d_with_index", {"X": ("x", x)},
+                      attrs={"ksize": [2, 2], "strides": [2, 2],
+                             "paddings": [0, 0]},
+                      out_slots=["Out", "Mask"])
+    t.check_output({"Out": out, "Mask": idx})
+
+
+def test_unpool_roundtrip():
+    x = _r((2, 3, 6, 6), 17)
+    out, idx = _np_max_pool_with_index(x, (2, 2), (2, 2), (0, 0))
+    t = OpTestHarness("unpool", {"X": ("p", out), "Indices": ("i", idx)},
+                      attrs={"ksize": [2, 2], "strides": [2, 2]})
+    ref = np.zeros((2, 3, 36), np.float32)
+    for n in range(2):
+        for c in range(3):
+            ref[n, c, idx[n, c].reshape(-1)] = out[n, c].reshape(-1)
+    t.check_output({"Out": ref.reshape(2, 3, 6, 6)})
+
+
+def test_pool3d():
+    x = _r((1, 2, 4, 4, 4), 18)
+    t = OpTestHarness("pool3d", {"X": ("x", x)},
+                      attrs={"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                             "paddings": [0, 0, 0],
+                             "pooling_type": "max"})
+    ref = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7))
+    t.check_output({"Out": ref})
+
+
+def test_spp():
+    x = _r((2, 3, 4, 4), 19)
+    t = OpTestHarness("spp", {"X": ("x", x)},
+                      attrs={"pyramid_height": 2, "pooling_type": "max"})
+    l0 = x.max(axis=(2, 3)).reshape(2, -1)
+    l1 = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5)).reshape(2, -1)
+    t.check_output({"Out": np.concatenate([l0, l1], axis=1)})
+
+
+def test_roi_pool():
+    x = np.arange(1 * 1 * 6 * 6, dtype=np.float32).reshape(1, 1, 6, 6)
+    rois = np.asarray([[0, 0, 0, 3, 3], [0, 2, 2, 5, 5]], np.float32)
+    t = OpTestHarness("roi_pool", {"X": ("x", x), "ROIs": ("r", rois)},
+                      attrs={"pooled_height": 2, "pooled_width": 2,
+                             "spatial_scale": 1.0})
+    def roi_ref(x1, y1, x2, y2):
+        reg = x[0, 0, y1:y2 + 1, x1:x2 + 1]
+        h, w = reg.shape
+        out = np.zeros((2, 2), np.float32)
+        for i in range(2):
+            for j in range(2):
+                hs, he = int(np.floor(i * h / 2)), int(np.ceil((i + 1) * h / 2))
+                ws, we = int(np.floor(j * w / 2)), int(np.ceil((j + 1) * w / 2))
+                out[i, j] = reg[hs:he, ws:we].max()
+        return out
+    ref = np.stack([roi_ref(0, 0, 3, 3)[None], roi_ref(2, 2, 5, 5)[None]])
+    t.check_output({"Out": ref})
+
+
+def test_conv3d_transpose_shape():
+    x = _r((1, 2, 3, 3, 3), 20)
+    w = _r((2, 4, 2, 2, 2), 21, 0.5)
+    t = OpTestHarness("conv3d_transpose",
+                      {"Input": ("x", x), "Filter": ("w", w)},
+                      attrs={"strides": [2, 2, 2], "paddings": [0, 0, 0]},
+                      out_slots=["Output"])
+    out = t.run_forward()["Output"]
+    assert out.shape == (1, 4, 6, 6, 6)
+
+
+# -- CTC --------------------------------------------------------------------
+
+def _np_ctc_loss(logits, labels, blank=0):
+    """Brute-force forward algorithm for one sequence."""
+    T, C = logits.shape
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    ext = [blank]
+    for l in labels:
+        ext += [int(l), blank]
+    U = len(ext)
+    alpha = np.zeros((T, U))
+    alpha[0, 0] = probs[0, ext[0]]
+    if U > 1:
+        alpha[0, 1] = probs[0, ext[1]]
+    for t in range(1, T):
+        for s in range(U):
+            a = alpha[t - 1, s]
+            if s >= 1:
+                a += alpha[t - 1, s - 1]
+            if s >= 2 and ext[s] != blank and ext[s] != ext[s - 2]:
+                a += alpha[t - 1, s - 2]
+            alpha[t, s] = a * probs[t, ext[s]]
+    p = alpha[T - 1, U - 1] + (alpha[T - 1, U - 2] if U > 1 else 0.0)
+    return -np.log(max(p, 1e-30))
+
+
+def test_warpctc_matches_bruteforce():
+    rng = np.random.RandomState(30)
+    T, C = 6, 5
+    logits1 = rng.randn(T, C).astype(np.float32)
+    logits2 = rng.randn(T, C).astype(np.float32)
+    labels1 = [1, 2]
+    labels2 = [3, 3, 1]
+    data = np.zeros((2, T, C), np.float32)
+    data[0], data[1] = logits1, logits2
+    lab = np.zeros((2, 3, 1), np.int32)
+    lab[0, :2, 0] = labels1
+    lab[1, :3, 0] = labels2
+    logits_r = RaggedPair(data, np.asarray([T, T], np.int32))
+    labels_r = RaggedPair(lab, np.asarray([2, 3], np.int32))
+    t = OpTestHarness("warpctc", {"Logits": ("lg", logits_r),
+                                  "Label": ("lb", labels_r)},
+                      attrs={"blank": 0}, out_slots=["Loss"])
+    got = np.asarray(t.run_forward()["Loss"]).reshape(-1)
+    ref = np.asarray([_np_ctc_loss(logits1, labels1),
+                      _np_ctc_loss(logits2, labels2)])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_warpctc_gradient_flows():
+    rng = np.random.RandomState(31)
+    data = rng.randn(2, 5, 4).astype(np.float32)
+    lab = np.asarray([[[1], [2]], [[3], [0]]], np.int32)
+    logits_r = RaggedPair(data, np.asarray([5, 4], np.int32))
+    labels_r = RaggedPair(lab, np.asarray([2, 1], np.int32))
+    t = OpTestHarness("warpctc", {"Logits": ("lg", logits_r),
+                                  "Label": ("lb", labels_r)},
+                      attrs={"blank": 0}, out_slots=["Loss"])
+    t.check_grad(["lg"], output_slot="Loss", eps=1e-2,
+                 max_relative_error=5e-2)
+
+
+def test_ctc_greedy_decoder():
+    # frames argmax: [1, 1, 0, 2, 2] -> collapse -> [1, 2]
+    probs = np.zeros((1, 5, 3), np.float32)
+    for t_, c in enumerate([1, 1, 0, 2, 2]):
+        probs[0, t_, c] = 1.0
+    r = RaggedPair(probs, np.asarray([5], np.int32))
+    t = OpTestHarness("ctc_greedy_decoder", {"Input": ("x", r)},
+                      attrs={"blank": 0})
+    out = t.run_forward()["Out"]  # LoDTensor (ragged host form)
+    seqs = out.sequences()
+    assert len(seqs[0]) == 2
+    np.testing.assert_array_equal(np.asarray(seqs[0]).reshape(-1), [1, 2])
+
+
+# -- RNN unit cells ---------------------------------------------------------
+
+def test_lstm_unit():
+    n, d = 3, 4
+    x = _r((n, 4 * d), 40)
+    c_prev = _r((n, d), 41)
+    t = OpTestHarness("lstm_unit", {"X": ("x", x), "C_prev": ("c", c_prev)},
+                      attrs={"forget_bias": 0.5}, out_slots=["C", "H"])
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    i, g, f, o = x[:, :d], x[:, d:2*d], x[:, 2*d:3*d], x[:, 3*d:]
+    c = sig(f + 0.5) * c_prev + sig(i) * np.tanh(g)
+    h = sig(o) * np.tanh(c)
+    t.check_output({"C": c.astype(np.float32), "H": h.astype(np.float32)},
+                   atol=1e-5)
+
+
+def test_gru_unit():
+    n, d = 3, 4
+    x = _r((n, 3 * d), 42)
+    h_prev = _r((n, d), 43)
+    w = _r((d, 3 * d), 44)
+    t = OpTestHarness("gru_unit", {"Input": ("x", x),
+                                   "HiddenPrev": ("h", h_prev),
+                                   "Weight": ("w", w)},
+                      out_slots=["Hidden"])
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    xu, xr, xc = x[:, :d], x[:, d:2*d], x[:, 2*d:]
+    u = sig(xu + h_prev @ w[:, :d])
+    r_ = sig(xr + h_prev @ w[:, d:2*d])
+    c = np.tanh(xc + (r_ * h_prev) @ w[:, 2*d:])
+    ref = u * h_prev + (1 - u) * c
+    t.check_output({"Hidden": ref.astype(np.float32)}, atol=1e-5)
+
+
+def test_lstmp_shapes():
+    n, t_, d, p = 2, 5, 4, 3
+    x = RaggedPair(_r((n, t_, 4 * d), 45), np.asarray([5, 3], np.int32))
+    w = _r((p, 4 * d), 46)
+    w_proj = _r((d, p), 47)
+    t = OpTestHarness("lstmp", {"Input": ("x", x), "Weight": ("w", w),
+                                "ProjWeight": ("wp", w_proj)},
+                      out_slots=["Projection", "LastH"])
+    outs = t.run_forward()
+    padded, lens = outs["Projection"].to_padded(max_len=t_)
+    assert np.asarray(padded).shape == (n, t_, p)
+    assert list(np.asarray(lens)) == [5, 3]
+    assert np.asarray(outs["LastH"]).shape == (n, p)
+
+
+# -- eval/ranking metrics ---------------------------------------------------
+
+def test_chunk_eval_iob():
+    # IOB, 2 chunk types; tag = type*2 + {B:0, I:1}; O = anything outside.
+    O = 99
+    label = np.asarray([[0, 1, O, 2, 3, O]], np.int32)   # chunks: A(0-1), B(3-4)
+    # prediction matches chunk A exactly, misses B's boundary
+    pred = np.asarray([[0, 1, O, 2, O, O]], np.int32)
+    t = OpTestHarness("chunk_eval", {"Inference": ("p", pred),
+                                     "Label": ("l", label)},
+                      attrs={"num_chunk_types": 2, "chunk_scheme": "IOB"},
+                      out_slots=["Precision", "Recall", "F1-Score",
+                                 "NumInferChunks", "NumLabelChunks",
+                                 "NumCorrectChunks"])
+    outs = t.run_forward()
+    assert int(outs["NumLabelChunks"]) == 2
+    assert int(outs["NumInferChunks"]) == 2
+    assert int(outs["NumCorrectChunks"]) == 1
+    np.testing.assert_allclose(float(outs["Precision"]), 0.5)
+    np.testing.assert_allclose(float(outs["Recall"]), 0.5)
+
+
+def test_positive_negative_pair():
+    score = np.asarray([[0.9], [0.2], [0.4], [0.7]], np.float32)
+    label = np.asarray([[1], [0], [1], [0]], np.float32)
+    qid = np.asarray([[0], [0], [0], [0]], np.int32)
+    t = OpTestHarness("positive_negative_pair",
+                      {"Score": ("s", score), "Label": ("l", label),
+                       "QueryID": ("q", qid)},
+                      out_slots=["PositivePair", "NegativePair",
+                                 "NeutralPair"])
+    outs = t.run_forward()
+    # pos items: 0 (.9), 2 (.4); neg: 1 (.2), 3 (.7)
+    # pairs: (0,1)+ (0,3)+ (2,1)+ (2,3)-  -> 3 correct, 1 wrong
+    assert float(np.asarray(outs["PositivePair"])[0]) == 3.0
+    assert float(np.asarray(outs["NegativePair"])[0]) == 1.0
+    assert float(np.asarray(outs["NeutralPair"])[0]) == 0.0
+
+
+# -- proximal optimizers ----------------------------------------------------
+
+def test_proximal_gd():
+    p = _r((4,), 50)
+    g = _r((4,), 51)
+    lr = np.asarray([0.1], np.float32)
+    t = OpTestHarness("proximal_gd",
+                      {"Param": ("p", p), "Grad": ("g", g),
+                       "LearningRate": ("lr", lr)},
+                      attrs={"l1": 0.05, "l2": 0.1},
+                      out_slots=["ParamOut"])
+    prox = p - 0.1 * g
+    ref = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * 0.05, 0) \
+        / (1 + 0.1 * 0.1)
+    t.check_output({"ParamOut": ref.astype(np.float32)}, atol=1e-6)
+
+
+def test_proximal_adagrad():
+    p, g, m = _r((4,), 52), _r((4,), 53), np.abs(_r((4,), 54)) + 0.1
+    lr = np.asarray([0.1], np.float32)
+    t = OpTestHarness("proximal_adagrad",
+                      {"Param": ("p", p), "Grad": ("g", g),
+                       "Moment": ("m", m), "LearningRate": ("lr", lr)},
+                      attrs={"l1": 0.0, "l2": 0.0},
+                      out_slots=["ParamOut", "MomentOut"])
+    m_out = m + g * g
+    ref = p - (0.1 / np.sqrt(m_out)) * g
+    t.check_output({"ParamOut": ref.astype(np.float32),
+                    "MomentOut": m_out.astype(np.float32)}, atol=1e-5)
