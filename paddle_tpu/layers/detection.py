@@ -6,7 +6,8 @@ from __future__ import annotations
 
 from ..layer_helper import LayerHelper
 
-__all__ = ["prior_box", "box_coder"]
+__all__ = ["prior_box", "box_coder", "iou_similarity", "bipartite_match",
+           "target_assign", "detection_output"]
 
 
 def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
@@ -39,4 +40,78 @@ def box_coder(prior_box_var, prior_box_v, target_box,
                      outputs={"OutputBox": out},
                      attrs={"code_type": code_type,
                             "box_normalized": box_normalized})
+    return out
+
+
+def iou_similarity(x, y):
+    """Pairwise IoU (reference: iou_similarity_op.cc)."""
+    helper = LayerHelper("iou_similarity")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="iou_similarity", inputs={"X": x, "Y": y},
+                     outputs={"Out": out})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5):
+    """Greedy bipartite matching (reference: detection.py bipartite_match)."""
+    helper = LayerHelper("bipartite_match")
+    match_indices = helper.create_tmp_variable("int32")
+    match_dist = helper.create_tmp_variable(dist_matrix.dtype)
+    helper.append_op(type="bipartite_match",
+                     inputs={"DistMat": dist_matrix},
+                     outputs={"ColToRowMatchIndices": match_indices,
+                              "ColToRowMatchDist": match_dist},
+                     attrs={"match_type": match_type,
+                            "dist_threshold": dist_threshold})
+    return match_indices, match_dist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0):
+    """Per-prior target assignment (reference: detection.py target_assign)."""
+    helper = LayerHelper("target_assign")
+    out = helper.create_tmp_variable(input.dtype)
+    out_weight = helper.create_tmp_variable("float32")
+    inputs = {"X": input, "MatchIndices": matched_indices}
+    if negative_indices is not None:
+        inputs["NegIndices"] = negative_indices
+    helper.append_op(type="target_assign", inputs=inputs,
+                     outputs={"Out": out, "OutWeight": out_weight},
+                     attrs={"mismatch_value": mismatch_value})
+    return out, out_weight
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01):
+    """Decode predicted deltas against priors, softmax the class logits,
+    then multiclass NMS (reference: detection.py:125-152 — box_coder +
+    softmax + transpose + multiclass_nms). `scores` is [N, M, C] raw
+    logits as in the reference. Static-shape output: [N, keep_top_k, 6]
+    rows (label, score, x1, y1, x2, y2), padded rows carry score -1."""
+    helper = LayerHelper("detection_output")
+    decoded = helper.create_tmp_variable(loc.dtype)
+    helper.append_op(type="box_coder",
+                     inputs={"PriorBox": prior_box,
+                             "PriorBoxVar": prior_box_var,
+                             "TargetBox": loc},
+                     outputs={"OutputBox": decoded},
+                     attrs={"code_type": "decode_center_size",
+                            "box_normalized": True})
+    probs = helper.create_tmp_variable(scores.dtype)
+    helper.append_op(type="softmax", inputs={"X": scores},
+                     outputs={"Out": probs}, attrs={"axis": -1})
+    probs_t = helper.create_tmp_variable(scores.dtype)
+    helper.append_op(type="transpose", inputs={"X": probs},
+                     outputs={"Out": probs_t}, attrs={"axis": [0, 2, 1]})
+    out = helper.create_tmp_variable(loc.dtype)
+    helper.append_op(type="multiclass_nms",
+                     inputs={"BBoxes": decoded, "Scores": probs_t},
+                     outputs={"Out": out},
+                     attrs={"background_label": background_label,
+                            "nms_threshold": nms_threshold,
+                            "nms_top_k": nms_top_k,
+                            "keep_top_k": keep_top_k,
+                            "score_threshold": score_threshold})
     return out

@@ -13,6 +13,7 @@ from . import optimizer_ops  # noqa: F401
 from . import metric_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
 from . import crf_ops  # noqa: F401
+from . import detection_ops  # noqa: F401
 
 from ..core.registry import OpRegistry
 

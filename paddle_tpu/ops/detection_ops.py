@@ -1,0 +1,296 @@
+"""Detection ops: SSD pipeline primitives.
+
+Reference parity: paddle/fluid/operators/{prior_box_op.cc, box_coder_op.cc,
+iou_similarity_op.cc, bipartite_match_op.cc, target_assign_op.cc,
+mine_hard_examples_op.cc, multiclass_nms_op.cc}. TPU-native design: every
+op is static-shape — NMS returns a fixed-capacity [N, keep_top_k] result
+with a validity count instead of the reference's variable-length LoD
+output, and bipartite matching runs as a bounded greedy lax.while-free
+argmax loop (#columns iterations, fully unrolled by XLA).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+
+@register_op("prior_box", no_grad_slots=["Input", "Image"])
+def _prior_box(ctx):
+    """SSD prior (anchor) boxes for one feature map (prior_box_op.cc).
+    Outputs Boxes [H, W, num_priors, 4] (normalized xmin,ymin,xmax,ymax)
+    and Variances broadcast to the same shape."""
+    feat = ctx.input("Input")    # [N, C, H, W]
+    image = ctx.input("Image")   # [N, C, IH, IW]
+    min_sizes = [float(s) for s in ctx.attr("min_sizes")]
+    max_sizes = [float(s) for s in ctx.attr("max_sizes", []) or []]
+    ars = [float(a) for a in ctx.attr("aspect_ratios", [1.0])]
+    variances = [float(v) for v in ctx.attr("variances",
+                                            [0.1, 0.1, 0.2, 0.2])]
+    flip = ctx.attr("flip", False)
+    clip = ctx.attr("clip", False)
+    step_w = ctx.attr("step_w", 0.0)
+    step_h = ctx.attr("step_h", 0.0)
+    offset = ctx.attr("offset", 0.5)
+
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    sw = step_w if step_w > 0 else iw / w
+    sh = step_h if step_h > 0 else ih / h
+
+    # expanded aspect ratios as the reference does (1.0 first, then each
+    # ar (+ reciprocal when flip))
+    out_ars = [1.0]
+    for a in ars:
+        if any(abs(a - e) < 1e-6 for e in out_ars):
+            continue
+        out_ars.append(a)
+        if flip:
+            out_ars.append(1.0 / a)
+
+    # reference pairs max_sizes[i] with min_sizes[i]: per min size, one
+    # prior per aspect ratio, then one square sqrt(min*max) prior
+    # (prior_box_op.h:107-129; num_priors = |ars|*|min| + |max|)
+    if max_sizes and len(max_sizes) != len(min_sizes):
+        raise ValueError("max_sizes must pair 1:1 with min_sizes")
+    widths, heights = [], []
+    for i, ms in enumerate(min_sizes):
+        for a in out_ars:
+            widths.append(ms * np.sqrt(a))
+            heights.append(ms / np.sqrt(a))
+        if max_sizes:
+            s = np.sqrt(ms * max_sizes[i])
+            widths.append(s)
+            heights.append(s)
+    widths = jnp.asarray(widths, jnp.float32)
+    heights = jnp.asarray(heights, jnp.float32)
+    num_priors = widths.shape[0]
+
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * sw
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)               # [h, w]
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    half_w = widths.reshape(1, 1, -1) / 2.0
+    half_h = heights.reshape(1, 1, -1) / 2.0
+    boxes = jnp.stack([(cxg - half_w) / iw, (cyg - half_h) / ih,
+                       (cxg + half_w) / iw, (cyg + half_h) / ih], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (h, w, num_priors, 4))
+    ctx.set_output("Boxes", boxes)
+    ctx.set_output("Variances", var)
+
+
+def _pairwise_iou(x, y):
+    """IoU between box sets x [N, 4] and y [M, 4] -> [N, M]."""
+    area = lambda b: jnp.maximum(b[:, 2] - b[:, 0], 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1], 0)
+    ix1 = jnp.maximum(x[:, None, 0], y[None, :, 0])
+    iy1 = jnp.maximum(x[:, None, 1], y[None, :, 1])
+    ix2 = jnp.minimum(x[:, None, 2], y[None, :, 2])
+    iy2 = jnp.minimum(x[:, None, 3], y[None, :, 3])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area(x)[:, None] + area(y)[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register_op("iou_similarity", no_grad_slots=["X", "Y"])
+def _iou_similarity(ctx):
+    """Pairwise IoU between two box sets (iou_similarity_op.cc):
+    X [N, 4], Y [M, 4] -> [N, M]."""
+    ctx.set_output("Out", _pairwise_iou(ctx.input("X"), ctx.input("Y")))
+
+
+@register_op("box_coder", no_grad_slots=["PriorBox", "PriorBoxVar"])
+def _box_coder(ctx):
+    """Encode/decode target boxes against priors (box_coder_op.cc)."""
+    prior = ctx.input("PriorBox")       # [M, 4] xmin,ymin,xmax,ymax
+    pvar = ctx.input("PriorBoxVar")     # [M, 4] or None
+    target = ctx.input("TargetBox")
+    code_type = ctx.attr("code_type", "encode_center_size")
+    normalized = ctx.attr("box_normalized", True)
+    off = 0.0 if normalized else 1.0
+
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    if pvar is None:
+        pvar = jnp.ones_like(prior)
+
+    if code_type.lower() in ("encode_center_size", "encode"):
+        # target [N, 4] -> out [N, M, 4]
+        tw = target[:, 2] - target[:, 0] + off
+        th = target[:, 3] - target[:, 1] + off
+        tcx = target[:, 0] + tw / 2
+        tcy = target[:, 1] + th / 2
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / pvar[None, :, 0]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / pvar[None, :, 1]
+        ow = jnp.log(tw[:, None] / pw[None, :]) / pvar[None, :, 2]
+        oh = jnp.log(th[:, None] / ph[None, :]) / pvar[None, :, 3]
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+    else:  # decode_center_size
+        # target [N, M, 4] deltas -> boxes [N, M, 4]
+        t = target
+        cx = pvar[None, :, 0] * t[..., 0] * pw[None, :] + pcx[None, :]
+        cy = pvar[None, :, 1] * t[..., 1] * ph[None, :] + pcy[None, :]
+        bw = jnp.exp(pvar[None, :, 2] * t[..., 2]) * pw[None, :]
+        bh = jnp.exp(pvar[None, :, 3] * t[..., 3]) * ph[None, :]
+        out = jnp.stack([cx - bw / 2, cy - bh / 2,
+                         cx + bw / 2 - off, cy + bh / 2 - off], axis=-1)
+    ctx.set_output("OutputBox", out)
+
+
+@register_op("bipartite_match", no_grad_slots=["DistMat"])
+def _bipartite_match(ctx):
+    """Greedy bipartite matching (bipartite_match_op.cc): repeatedly take
+    the globally-largest entry, retire its row+col. Bounded loop of
+    min(N, M) steps — static for XLA."""
+    dist = ctx.input("DistMat")  # [N, M] similarity (rows = gt, cols=prior)
+    n, m = dist.shape
+    steps = min(n, m)
+
+    def body(k, state):
+        d, row_of_col, dist_of_col = state
+        flat = jnp.argmax(d)
+        i, j = flat // m, flat % m
+        best = d[i, j]
+        found = best > -jnp.inf
+        row_of_col = jnp.where(found, row_of_col.at[j].set(i), row_of_col)
+        dist_of_col = jnp.where(found, dist_of_col.at[j].set(best),
+                                dist_of_col)
+        d = jnp.where(found, d.at[i, :].set(-jnp.inf), d)
+        d = jnp.where(found, d.at[:, j].set(-jnp.inf), d)
+        return d, row_of_col, dist_of_col
+
+    row_of_col = jnp.full((m,), -1, jnp.int32)
+    dist_of_col = jnp.zeros((m,), dist.dtype)
+    _, row_of_col, dist_of_col = jax.lax.fori_loop(
+        0, steps, body, (dist, row_of_col, dist_of_col))
+    match_type = ctx.attr("match_type", "bipartite")
+    if match_type == "per_prediction":
+        thr = ctx.attr("dist_threshold", 0.5)
+        best_row = jnp.argmax(ctx.input("DistMat"), axis=0).astype(jnp.int32)
+        best_val = jnp.max(ctx.input("DistMat"), axis=0)
+        extra = (row_of_col < 0) & (best_val > thr)
+        row_of_col = jnp.where(extra, best_row, row_of_col)
+        dist_of_col = jnp.where(extra, best_val, dist_of_col)
+    ctx.set_output("ColToRowMatchIndices", row_of_col[None, :])
+    ctx.set_output("ColToRowMatchDist", dist_of_col[None, :])
+
+
+@register_op("target_assign", no_grad_slots=["X", "MatchIndices",
+                                             "NegIndices"])
+def _target_assign(ctx):
+    """Assign per-prior regression/classification targets from matched gt
+    (target_assign_op.cc): out[j] = X[match[j]] where matched, else
+    mismatch_value; weight 1 where matched (or negative), else 0."""
+    x = ctx.input("X")                    # [P, K] per-gt targets
+    match = ctx.input("MatchIndices")     # [1, M] row index per prior
+    mismatch_value = ctx.attr("mismatch_value", 0)
+    m = match.shape[-1]
+    match = match.reshape(-1)
+    matched = match >= 0
+    safe = jnp.clip(match, 0, x.shape[0] - 1)
+    if x.ndim == 3:
+        # reference (target_assign_op.h) gathers per-prior columns:
+        # out[j] = X[match[j], j, :]
+        gathered = x[safe, jnp.arange(m)]
+    else:
+        gathered = x[safe]
+    out = jnp.where(matched[:, None], gathered,
+                    jnp.full((m, gathered.shape[-1]), mismatch_value,
+                             x.dtype))
+    wt = matched.astype(jnp.float32)[:, None]
+    neg = ctx.input("NegIndices")
+    if neg is not None:
+        # NegIndices is -1-padded (mine_hard_examples); a raw scatter
+        # would wrap -1 to the last prior, so count only valid hits
+        neg = neg.reshape(-1).astype(jnp.int32)
+        valid = (neg >= 0).astype(jnp.float32)
+        hits = jnp.zeros((m,), jnp.float32).at[
+            jnp.clip(neg, 0, m - 1)].add(valid)
+        wt = jnp.maximum(wt, (hits > 0).astype(jnp.float32)[:, None])
+    ctx.set_output("Out", out[None])
+    ctx.set_output("OutWeight", wt[None])
+
+
+@register_op("mine_hard_examples", no_grad_slots=["ClsLoss", "MatchIndices",
+                                                  "MatchDist"])
+def _mine_hard_examples(ctx):
+    """Hard-negative mining (mine_hard_examples_op.cc): pick the
+    highest-loss unmatched priors, neg:pos <= neg_pos_ratio. Static-shape
+    form: NegIndices is [M] with -1 padding + UpdatedMatchIndices."""
+    cls_loss = ctx.input("ClsLoss")         # [1, M] or [M]
+    match = ctx.input("MatchIndices").reshape(-1)
+    loss = cls_loss.reshape(-1)
+    m = loss.shape[0]
+    ratio = ctx.attr("neg_pos_ratio", 3.0)
+    num_pos = jnp.sum((match >= 0).astype(jnp.int32))
+    num_neg = jnp.minimum((num_pos * ratio).astype(jnp.int32),
+                          m - num_pos)
+    neg_loss = jnp.where(match >= 0, -jnp.inf, loss)
+    order = jnp.argsort(-neg_loss)          # highest loss first
+    ranks = jnp.arange(m)
+    neg_idx = jnp.where(ranks < num_neg, order, -1).astype(jnp.int32)
+    ctx.set_output("NegIndices", neg_idx[None])
+    ctx.set_output("UpdatedMatchIndices", match[None])
+
+
+@register_op("multiclass_nms", no_grad_slots=["BBoxes", "Scores"])
+def _multiclass_nms(ctx):
+    """Multi-class NMS (multiclass_nms_op.cc), TPU static-shape form:
+    returns Out [N, keep_top_k, 6] = (label, score, x1, y1, x2, y2) with
+    score -1 padding, plus NumDetections [N]."""
+    bboxes = ctx.input("BBoxes")   # [N, M, 4]
+    scores = ctx.input("Scores")   # [N, C, M]
+    score_threshold = ctx.attr("score_threshold", 0.0)
+    nms_threshold = ctx.attr("nms_threshold", 0.3)
+    nms_top_k = int(ctx.attr("nms_top_k", 64))
+    keep_top_k = int(ctx.attr("keep_top_k", 64))
+    background_label = ctx.attr("background_label", 0)
+
+    def one_class(boxes, cls_scores):
+        # reference allows -1 = "keep all" for nms_top_k/keep_top_k
+        k = boxes.shape[0] if nms_top_k <= 0 else min(nms_top_k,
+                                                      boxes.shape[0])
+        top_scores, top_idx = jax.lax.top_k(cls_scores, k)
+        top_boxes = boxes[top_idx]
+        ious = _pairwise_iou(top_boxes, top_boxes)
+        # greedy suppression: keep i if no higher-scoring kept j overlaps
+        def body(i, keep):
+            overlap = (ious[i] > nms_threshold) & keep & \
+                (jnp.arange(k) < i)
+            return keep.at[i].set(~jnp.any(overlap) & keep[i])
+        keep0 = top_scores > score_threshold
+        keep = jax.lax.fori_loop(0, k, body, keep0)
+        return top_scores, top_boxes, keep
+
+    def one_image(boxes, img_scores):
+        all_s, all_b, all_l, all_k = [], [], [], []
+        for c in range(img_scores.shape[0]):
+            if c == background_label:
+                continue
+            s, b, kmask = one_class(boxes, img_scores[c])
+            all_s.append(jnp.where(kmask, s, -1.0))
+            all_b.append(b)
+            all_l.append(jnp.full(s.shape, c, jnp.float32))
+            all_k.append(kmask)
+        s = jnp.concatenate(all_s)
+        b = jnp.concatenate(all_b, axis=0)
+        l = jnp.concatenate(all_l)
+        kk = s.shape[0] if keep_top_k <= 0 else min(keep_top_k, s.shape[0])
+        top_s, idx = jax.lax.top_k(s, kk)
+        out = jnp.concatenate([l[idx][:, None], top_s[:, None],
+                               b[idx]], axis=1)
+        num = jnp.sum((top_s > 0).astype(jnp.int32))
+        # pad invalid rows with score -1 (already -1 from the mask)
+        return out, num
+
+    outs, nums = jax.vmap(one_image)(bboxes, scores)
+    ctx.set_output("Out", outs)
+    ctx.set_output("NumDetections", nums)

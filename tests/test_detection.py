@@ -1,0 +1,235 @@
+"""Detection op battery (reference: prior_box_op.cc, box_coder_op.cc,
+iou_similarity_op.cc, bipartite_match_op.cc, target_assign_op.cc,
+mine_hard_examples_op.cc, multiclass_nms_op.cc + detection.py layers)."""
+import numpy as np
+
+from op_test import OpTestHarness
+
+
+def _iou_np(a, b):
+    area = lambda x: np.maximum(x[:, 2] - x[:, 0], 0) * \
+        np.maximum(x[:, 3] - x[:, 1], 0)
+    x1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    union = area(a)[:, None] + area(b)[None, :] - inter
+    return np.where(union > 0, inter / union, 0.0)
+
+
+def test_iou_similarity():
+    a = np.asarray([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    b = np.asarray([[0, 0, 2, 2], [2, 2, 4, 4], [5, 5, 6, 6]], np.float32)
+    t = OpTestHarness("iou_similarity", {"X": ("x", a), "Y": ("y", b)})
+    t.check_output({"Out": _iou_np(a, b).astype(np.float32)}, atol=1e-6)
+
+
+def test_prior_box_geometry():
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    img = np.zeros((1, 3, 100, 100), np.float32)
+    t = OpTestHarness("prior_box", {"Input": ("f", feat), "Image": ("i", img)},
+                      attrs={"min_sizes": [10.0], "max_sizes": [20.0],
+                             "aspect_ratios": [2.0], "flip": True,
+                             "variances": [0.1, 0.1, 0.2, 0.2],
+                             "clip": True, "step_w": 0.0, "step_h": 0.0,
+                             "offset": 0.5},
+                      out_slots=["Boxes", "Variances"])
+    outs = t.run_forward()
+    boxes = np.asarray(outs["Boxes"])
+    # priors per cell: ar(1) + ar(2) + ar(0.5) + 1 max-size = 4
+    assert boxes.shape == (2, 2, 4, 4)
+    # first cell center = (25, 25); min_size 10, ar 1 -> box 20..30 normalized
+    np.testing.assert_allclose(boxes[0, 0, 0], [0.20, 0.20, 0.30, 0.30],
+                               atol=1e-6)
+    var = np.asarray(outs["Variances"])
+    np.testing.assert_allclose(var[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_box_coder_roundtrip():
+    prior = np.asarray([[0.1, 0.1, 0.5, 0.5], [0.3, 0.3, 0.9, 0.9]],
+                       np.float32)
+    pvar = np.full((2, 4), 0.1, np.float32)
+    gt = np.asarray([[0.15, 0.2, 0.55, 0.6]], np.float32)
+    enc = OpTestHarness("box_coder",
+                        {"PriorBox": ("p", prior), "PriorBoxVar": ("v", pvar),
+                         "TargetBox": ("t", gt)},
+                        attrs={"code_type": "encode_center_size"},
+                        out_slots=["OutputBox"])
+    deltas = np.asarray(enc.run_forward()["OutputBox"])  # [1, 2, 4]
+    dec = OpTestHarness("box_coder",
+                        {"PriorBox": ("p", prior), "PriorBoxVar": ("v", pvar),
+                         "TargetBox": ("t", deltas.astype(np.float32))},
+                        attrs={"code_type": "decode_center_size"},
+                        out_slots=["OutputBox"])
+    back = np.asarray(dec.run_forward()["OutputBox"])
+    np.testing.assert_allclose(back[0, 0], gt[0], atol=1e-5)
+    np.testing.assert_allclose(back[0, 1], gt[0], atol=1e-5)
+
+
+def test_bipartite_match_greedy():
+    dist = np.asarray([[0.9, 0.2, 0.1],
+                       [0.8, 0.7, 0.3]], np.float32)  # 2 gt x 3 priors
+    t = OpTestHarness("bipartite_match", {"DistMat": ("d", dist)},
+                      out_slots=["ColToRowMatchIndices",
+                                 "ColToRowMatchDist"],
+                      out_dtypes={"ColToRowMatchIndices": "int32"})
+    outs = t.run_forward()
+    idx = np.asarray(outs["ColToRowMatchIndices"])[0]
+    # greedy: (0, col0, .9) taken first; then gt1's best remaining col1 (.7)
+    assert idx[0] == 0 and idx[1] == 1 and idx[2] == -1
+    np.testing.assert_allclose(
+        np.asarray(outs["ColToRowMatchDist"])[0][:2], [0.9, 0.7], atol=1e-6)
+
+
+def test_target_assign():
+    x = np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32)  # 2 gt targets
+    match = np.asarray([[1, -1, 0]], np.int32)
+    t = OpTestHarness("target_assign",
+                      {"X": ("x", x), "MatchIndices": ("m", match)},
+                      attrs={"mismatch_value": 0},
+                      out_slots=["Out", "OutWeight"])
+    outs = t.run_forward()
+    np.testing.assert_allclose(np.asarray(outs["Out"])[0],
+                               [[3, 4], [0, 0], [1, 2]])
+    np.testing.assert_allclose(np.asarray(outs["OutWeight"])[0],
+                               [[1], [0], [1]])
+
+
+def test_target_assign_padded_neg_indices():
+    # -1 padding in NegIndices must NOT grant weight to the last prior
+    x = np.asarray([[1.0, 2.0]], np.float32)
+    match = np.asarray([[0, -1, -1, -1]], np.int32)
+    neg = np.asarray([[1, -1, -1, -1]], np.int32)  # only prior 1 mined
+    t = OpTestHarness("target_assign",
+                      {"X": ("x", x), "MatchIndices": ("m", match),
+                       "NegIndices": ("n", neg)},
+                      attrs={"mismatch_value": 0},
+                      out_slots=["Out", "OutWeight"])
+    outs = t.run_forward()
+    np.testing.assert_allclose(np.asarray(outs["OutWeight"])[0],
+                               [[1], [1], [0], [0]])
+
+
+def test_prior_box_pairs_min_max_sizes():
+    # 2 min sizes x (1 ar + paired max) -> 4 priors, sqrt(min_i * max_i)
+    feat = np.zeros((1, 8, 1, 1), np.float32)
+    img = np.zeros((1, 3, 100, 100), np.float32)
+    t = OpTestHarness("prior_box", {"Input": ("f", feat), "Image": ("i", img)},
+                      attrs={"min_sizes": [10.0, 20.0],
+                             "max_sizes": [20.0, 30.0],
+                             "aspect_ratios": [1.0], "flip": False,
+                             "variances": [0.1, 0.1, 0.2, 0.2],
+                             "clip": False, "step_w": 0.0, "step_h": 0.0,
+                             "offset": 0.5},
+                      out_slots=["Boxes", "Variances"])
+    boxes = np.asarray(t.run_forward()["Boxes"])
+    assert boxes.shape == (1, 1, 4, 4)
+    # prior 1 is the sqrt(10*20) square, prior 3 the sqrt(20*30) square
+    w1 = (boxes[0, 0, 1, 2] - boxes[0, 0, 1, 0]) * 100
+    w3 = (boxes[0, 0, 3, 2] - boxes[0, 0, 3, 0]) * 100
+    np.testing.assert_allclose(w1, np.sqrt(200.0), rtol=1e-5)
+    np.testing.assert_allclose(w3, np.sqrt(600.0), rtol=1e-5)
+
+
+def test_target_assign_3d_per_prior_gather():
+    # X [num_gt, M, K]: reference gathers out[j] = X[match[j], j, :]
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    match = np.asarray([[1, -1, 0]], np.int32)
+    t = OpTestHarness("target_assign",
+                      {"X": ("x", x), "MatchIndices": ("m", match)},
+                      attrs={"mismatch_value": 0},
+                      out_slots=["Out", "OutWeight"])
+    outs = t.run_forward()
+    out = np.asarray(outs["Out"])[0]
+    assert out.shape == (3, 4)
+    np.testing.assert_allclose(out[0], x[1, 0])
+    np.testing.assert_allclose(out[1], np.zeros(4))
+    np.testing.assert_allclose(out[2], x[0, 2])
+
+
+def test_multiclass_nms_keep_all_sentinel():
+    # reference API: nms_top_k / keep_top_k == -1 means keep everything
+    boxes = np.asarray([[[0, 0, 1, 1], [5, 5, 6, 6]]], np.float32)
+    scores = np.zeros((1, 2, 2), np.float32)
+    scores[0, 1] = [0.9, 0.7]
+    t = OpTestHarness("multiclass_nms",
+                      {"BBoxes": ("b", boxes), "Scores": ("s", scores)},
+                      attrs={"nms_threshold": 0.5, "score_threshold": 0.05,
+                             "nms_top_k": -1, "keep_top_k": -1,
+                             "background_label": 0},
+                      out_slots=["Out", "NumDetections"],
+                      out_dtypes={"NumDetections": "int32"})
+    outs = t.run_forward()
+    assert int(np.asarray(outs["NumDetections"])[0]) == 2
+
+
+def test_mine_hard_examples():
+    loss = np.asarray([[0.1, 0.9, 0.5, 0.8]], np.float32)
+    match = np.asarray([[0, -1, -1, -1]], np.int32)  # 1 positive
+    t = OpTestHarness("mine_hard_examples",
+                      {"ClsLoss": ("l", loss), "MatchIndices": ("m", match)},
+                      attrs={"neg_pos_ratio": 2.0},
+                      out_slots=["NegIndices", "UpdatedMatchIndices"],
+                      out_dtypes={"NegIndices": "int32",
+                                  "UpdatedMatchIndices": "int32"})
+    outs = t.run_forward()
+    neg = np.asarray(outs["NegIndices"])[0]
+    # 1 pos * ratio 2 = 2 negatives: the highest-loss unmatched are 1, 3
+    assert set(neg[neg >= 0].tolist()) == {1, 3}
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    # one image, 2 classes (class 0 = background), 3 boxes; boxes 0/1
+    # overlap heavily, box 2 is separate.
+    boxes = np.asarray([[[0, 0, 2, 2], [0.1, 0, 2, 2], [5, 5, 6, 6]]],
+                       np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]
+    t = OpTestHarness("multiclass_nms",
+                      {"BBoxes": ("b", boxes), "Scores": ("s", scores)},
+                      attrs={"nms_threshold": 0.5, "score_threshold": 0.05,
+                             "nms_top_k": 3, "keep_top_k": 3,
+                             "background_label": 0},
+                      out_slots=["Out", "NumDetections"],
+                      out_dtypes={"NumDetections": "int32"})
+    outs = t.run_forward()
+    num = int(np.asarray(outs["NumDetections"])[0])
+    out = np.asarray(outs["Out"])[0]
+    assert num == 2  # box 1 suppressed by box 0
+    kept_scores = sorted(out[:num, 1].tolist(), reverse=True)
+    np.testing.assert_allclose(kept_scores, [0.9, 0.7], atol=1e-6)
+
+
+def test_detection_output_layer_end_to_end():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        loc = layers.data("loc", [2, 4], append_batch_size=True,
+                          dtype="float32")
+        scores = layers.data("scores", [2, 2], dtype="float32")
+        pb = layers.data("pb", [2, 4], append_batch_size=False,
+                         dtype="float32")
+        pbv = layers.data("pbv", [2, 4], append_batch_size=False,
+                          dtype="float32")
+        out = layers.detection_output(loc, scores, pb, pbv,
+                                      nms_top_k=2, keep_top_k=2)
+    exe = pt.Executor()
+    exe.run(startup)
+    # scores are [N, M, C] raw logits (reference contract); softmax of
+    # [0, ln 9] = [0.1, 0.9] and [0, ln 4] = [0.2, 0.8]
+    feed = {
+        "loc": np.zeros((1, 2, 4), np.float32),  # no delta: decode = prior
+        "scores": np.log(np.asarray([[[1.0, 9.0], [1.0, 4.0]]], np.float32)),
+        "pb": np.asarray([[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]],
+                         np.float32),
+        "pbv": np.full((2, 4), 0.1, np.float32),
+    }
+    (res,) = exe.run(main, feed=feed, fetch_list=[out])
+    assert res.shape == (1, 2, 6)
+    # both priors far apart -> both kept, class 1 scores 0.9/0.8
+    np.testing.assert_allclose(sorted(res[0, :, 1].tolist(), reverse=True),
+                               [0.9, 0.8], atol=1e-6)
