@@ -93,3 +93,43 @@ def gaussian_random(shape, dtype="float32", mean=0.0, std=1.0, seed=0):
                             helper.main_program.desc.next_seed()})
     out.stop_gradient = True
     return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Crop x to `shape` (a list or a reference Variable) at `offsets`
+    (reference: crop_op.cc)."""
+    helper = LayerHelper("crop", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    inputs = {"X": x}
+    attrs = {"offsets": list(offsets or [])}
+    if shape is not None and not isinstance(shape, (list, tuple)):
+        inputs["Y"] = shape
+    else:
+        attrs["shape"] = list(shape or [])
+    helper.append_op(type="crop", inputs=inputs, outputs={"Out": out},
+                     attrs=attrs)
+    return out
+
+
+def _make_batch_size_like(op_type):
+    def fn(input, shape, dtype="float32", input_dim_idx=0,
+           output_dim_idx=0, **kw):
+        helper = LayerHelper(op_type)
+        out = helper.create_tmp_variable(dtype)
+        out.stop_gradient = True
+        helper.append_op(type=op_type, inputs={"Input": input},
+                         outputs={"Out": out},
+                         attrs={"shape": list(shape), "dtype": dtype,
+                                "input_dim_idx": input_dim_idx,
+                                "output_dim_idx": output_dim_idx, **kw})
+        return out
+    fn.__name__ = op_type
+    return fn
+
+
+uniform_random_batch_size_like = _make_batch_size_like(
+    "uniform_random_batch_size_like")
+gaussian_random_batch_size_like = _make_batch_size_like(
+    "gaussian_random_batch_size_like")
+__all__ += ["crop", "uniform_random_batch_size_like",
+            "gaussian_random_batch_size_like"]

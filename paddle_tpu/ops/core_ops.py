@@ -141,6 +141,46 @@ def _assign_value(ctx):
     ctx.set_output("Out", jnp.asarray(vals))
 
 
+@register_op("fill")
+def _fill(ctx):
+    """Fill Out with the literal `value` list (reference: fill_op.cc)."""
+    import numpy as _np
+    shape = ctx.attr("shape")
+    dtype = jnp_dtype(ctx.attr("dtype", "float32"))
+    vals = _np.asarray(ctx.attr("value"), dtype=dtype).reshape(shape)
+    ctx.set_output("Out", jnp.asarray(vals))
+
+
+def _batch_size_like_shape(ctx):
+    """Output shape = attr `shape` with the batch dim taken from Input
+    (reference: batch_size_like.h)."""
+    shape = list(ctx.attr("shape"))
+    in_idx = ctx.attr("input_dim_idx", 0)
+    out_idx = ctx.attr("output_dim_idx", 0)
+    shape[out_idx] = ctx.input("Input").shape[in_idx]
+    return tuple(shape)
+
+
+@register_op("uniform_random_batch_size_like", no_grad_slots=["Input"])
+def _uniform_random_batch_size_like(ctx):
+    """reference: uniform_random_batch_size_like_op.cc"""
+    dtype = jnp_dtype(ctx.attr("dtype", "float32"))
+    lo, hi = ctx.attr("min", -1.0), ctx.attr("max", 1.0)
+    out = jax.random.uniform(_op_key(ctx), _batch_size_like_shape(ctx),
+                             dtype=jnp.float32, minval=lo, maxval=hi)
+    ctx.set_output("Out", out.astype(dtype))
+
+
+@register_op("gaussian_random_batch_size_like", no_grad_slots=["Input"])
+def _gaussian_random_batch_size_like(ctx):
+    """reference: gaussian_random_batch_size_like_op.cc"""
+    dtype = jnp_dtype(ctx.attr("dtype", "float32"))
+    mean, std = ctx.attr("mean", 0.0), ctx.attr("std", 1.0)
+    out = mean + std * jax.random.normal(
+        _op_key(ctx), _batch_size_like_shape(ctx), dtype=jnp.float32)
+    ctx.set_output("Out", out.astype(dtype))
+
+
 @register_op("randint")
 def _randint(ctx):
     shape = ctx.attr("shape")

@@ -491,3 +491,9 @@ def _is_empty(ctx):
     x = ctx.input("X")
     size = int(_np.prod(x.shape)) if x.shape else 0
     ctx.set_output("Out", jnp.asarray(size == 0))
+
+
+@register_op("minus")
+def _minus(ctx):
+    """Out = X - Y (reference: minus_op.cc)."""
+    ctx.set_output("Out", ctx.input("X") - ctx.input("Y"))

@@ -229,3 +229,55 @@ def _proximal_adagrad(ctx):
         out = prox / (1.0 + eff_lr * l2)
     ctx.set_output("ParamOut", out)
     ctx.set_output("MomentOut", m_out)
+
+
+@register_op("average_accumulates", no_grad_slots=[
+    "param", "in_sum_1", "in_sum_2", "in_sum_3", "in_num_accumulates",
+    "in_old_num_accumulates", "in_num_updates"])
+def _average_accumulates(ctx):
+    """Sliding-window parameter sum for ModelAverage (reference:
+    average_accumulates_op.h). Three-tier sums: sum_1 per-step, rolled
+    into sum_2 every 16384 updates, both folded into sum_3 when the
+    window [min_avg_window, min(max_avg_window, num_updates*rate)]
+    closes. The reference's roll/close branches become jnp.where —
+    shapes stay static so the whole update fuses into the step program."""
+    p = ctx.input("param")
+    s1 = ctx.input("in_sum_1")
+    s2 = ctx.input("in_sum_2")
+    s3 = ctx.input("in_sum_3")
+    num_acc = ctx.input("in_num_accumulates").reshape(()).astype(jnp.int32)
+    old_num = ctx.input("in_old_num_accumulates").reshape(()) \
+        .astype(jnp.int32)
+    num_upd = ctx.input("in_num_updates").reshape(()).astype(jnp.int32)
+    rate = ctx.attr("average_window", 0.0)
+    min_w = ctx.attr("min_average_window", 10000)
+    max_w = ctx.attr("max_average_window", 10000)
+    k_max = 16384  # kMaxNumAccumulates
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    o1 = s1 + p
+    o2 = s2
+    # precision roll. The reference's in/out tensors alias the same
+    # accumulator, so its "in_sum_1" reads are post-update values —
+    # mirror that sequencing here.
+    roll = (num_upd % k_max) == 0
+    o2 = jnp.where(roll, o2 + o1, o2)
+    o1 = jnp.where(roll, jnp.zeros_like(o1), o1)
+    # window close: discard the old sum
+    close = (num_acc >= min_w) & \
+        (num_acc.astype(jnp.float32) >=
+         jnp.minimum(jnp.float32(max_w),
+                     num_upd.astype(jnp.float32) * rate))
+    o3 = jnp.where(close, o1 + o2, s3)
+    o1 = jnp.where(close, jnp.zeros_like(o1), o1)
+    o2 = jnp.where(close, jnp.zeros_like(o2), o2)
+    old_num = jnp.where(close, num_acc, old_num)
+    num_acc = jnp.where(close, jnp.int32(0), num_acc)
+
+    ctx.set_output("out_sum_1", o1)
+    ctx.set_output("out_sum_2", o2)
+    ctx.set_output("out_sum_3", o3)
+    ctx.set_output("out_num_accumulates", num_acc.reshape(1))
+    ctx.set_output("out_old_num_accumulates", old_num.reshape(1))
+    ctx.set_output("out_num_updates", num_upd.reshape(1))

@@ -579,3 +579,29 @@ def _lstmp(ctx):
     ctx.set_output("Cell", RaggedPair(cells, x.lengths))
     ctx.set_output("LastH", r_last)
     ctx.set_output("LastC", c_last)
+
+
+@register_op_SEQ("ctc_align", no_grad_slots=["Input"])
+def _ctc_align(ctx):
+    """Merge repeated tokens (optional) then drop blanks (reference:
+    ctc_align_op.cc). Static-shape compaction as in ctc_greedy_decoder."""
+    x = _as_ragged(ctx.input("Input"))      # [B, T, 1] or [B, T] token ids
+    blank = ctx.attr("blank", 0)
+    merge = ctx.attr("merge_repeated", True)
+    ids = x.data
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    ids = ids.astype(jnp.int32)
+    B, T = ids.shape
+    mask = x.mask()
+    keep = (ids != blank) & mask
+    if merge:
+        prev = jnp.concatenate([jnp.full((B, 1), -1, jnp.int32),
+                                ids[:, :-1]], axis=1)
+        keep = keep & (ids != prev)
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out_lens = keep.astype(jnp.int32).sum(axis=1)
+    buf = jnp.zeros((B, T + 1), jnp.int32)
+    scatter_pos = jnp.where(keep, pos, T)
+    buf = buf.at[jnp.arange(B)[:, None], scatter_pos].set(ids)
+    ctx.set_output("Output", RaggedPair(buf[:, :T, None], out_lens))

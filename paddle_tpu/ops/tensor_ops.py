@@ -275,3 +275,26 @@ def _multiplex(ctx):
     xs = jnp.stack(ctx.inputs("X"), axis=0)      # [k, n, ...]
     n = xs.shape[1]
     ctx.set_output("Out", xs[ids, jnp.arange(n)])
+
+
+@register_op("crop", no_grad_slots=["Y", "Offsets"])
+def _crop(ctx):
+    """Crop X at `offsets` to the shape of Y (or the `shape` attr);
+    offsets may also arrive as a runtime Offsets tensor which overrides
+    the attr (reference: crop_op.cc)."""
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    shape = list(y.shape) if y is not None else list(ctx.attr("shape"))
+    if len(shape) != x.ndim:
+        raise ValueError(f"crop shape rank {len(shape)} != input rank "
+                         f"{x.ndim}")
+    off_in = ctx.input("Offsets")
+    if off_in is not None:
+        starts = off_in.reshape(-1).astype(jnp.int32)
+        ctx.set_output("Out", jax.lax.dynamic_slice(
+            x, [starts[i] for i in range(x.ndim)], shape))
+        return
+    offsets = list(ctx.attr("offsets") or [])
+    offsets += [0] * (x.ndim - len(offsets))
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    ctx.set_output("Out", x[idx])

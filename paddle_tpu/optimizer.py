@@ -8,6 +8,7 @@ lands in ONE jitted XLA program per training step.
 """
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
 from . import framework
@@ -378,6 +379,114 @@ class LarsMomentumOptimizer(Optimizer):
             outputs={"ParamOut": p, "VelocityOut": v},
             attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
                    "lars_weight_decay": self._lars_weight_decay})
+
+
+class ModelAverage(Optimizer):
+    """Sliding-window parameter averaging (reference: optimizer.py:811).
+
+    Appends an `average_accumulates` op per parameter to the main
+    program; `apply()` swaps averaged values into the parameters (backing
+    up current values in the grad vars) and `restore()` swaps back.
+    apply/restore are small separate programs run on demand, exactly as
+    the reference builds them."""
+
+    def __init__(self, params_grads, average_window_rate,
+                 min_average_window=10000, max_average_window=10000, **kw):
+        super().__init__(0.0, **kw)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self.params_grads = [(p, g) for p, g in params_grads
+                             if g is not None]
+        for param, _ in self.params_grads:
+            self._append_average_accumulate_op(param)
+
+        self.apply_program = Program()
+        with framework.program_guard(self.apply_program,
+                                     default_startup_program()):
+            for param, grad in self.params_grads:
+                self._add_average_apply_op(param, grad)
+        self.restore_program = Program()
+        with framework.program_guard(self.restore_program,
+                                     default_startup_program()):
+            for param, grad in self.params_grads:
+                self._add_average_restore_op(param, grad)
+
+    def _clone_into(self, block, var):
+        return block.create_var(name=var.name, shape=list(var.shape),
+                                dtype=var.dtype, persistable=True)
+
+    def _add_average_apply_op(self, param, grad):
+        block = self.apply_program.global_block()
+        p = self._clone_into(block, param)
+        g = self._clone_into(block, grad)
+        s1 = self._clone_into(block, self._get_accumulator("sum_1", param))
+        s2 = self._clone_into(block, self._get_accumulator("sum_2", param))
+        s3 = self._clone_into(block, self._get_accumulator("sum_3", param))
+        num_acc = self._clone_into(
+            block, self._get_accumulator("num_accumulates", param))
+        old_num = self._clone_into(
+            block, self._get_accumulator("old_num_accumulates", param))
+        # backup current param value into the grad var
+        block.append_op("assign", inputs={"X": p}, outputs={"Out": g})
+        # param = (sum_1 + sum_2 + sum_3) / (num_accumulates + old_num)
+        total = block.create_var(shape=list(param.shape), dtype=param.dtype)
+        block.append_op("sum", inputs={"X": [s1, s2, s3]},
+                        outputs={"Out": total})
+        n = block.create_var(shape=[1], dtype="int32")
+        block.append_op("sum", inputs={"X": [num_acc, old_num]},
+                        outputs={"Out": n})
+        nf = block.create_var(shape=[1], dtype="float32")
+        block.append_op("cast", inputs={"X": n}, outputs={"Out": nf},
+                        attrs={"in_dtype": "int32",
+                               "out_dtype": "float32"})
+        block.append_op("elementwise_div", inputs={"X": total, "Y": nf},
+                        outputs={"Out": p}, attrs={"axis": -1})
+
+    def _add_average_restore_op(self, param, grad):
+        block = self.restore_program.global_block()
+        p = self._clone_into(block, param)
+        g = self._clone_into(block, grad)
+        block.append_op("assign", inputs={"X": g}, outputs={"Out": p})
+
+    def _append_average_accumulate_op(self, param):
+        block = param.block.program.global_block()
+        s1 = self._add_accumulator("sum_1", param)
+        s2 = self._add_accumulator("sum_2", param)
+        s3 = self._add_accumulator("sum_3", param)
+        num_acc = self._add_accumulator("num_accumulates", param,
+                                        dtype="int32", shape=[1])
+        old_num = self._add_accumulator("old_num_accumulates", param,
+                                        dtype="int32", shape=[1])
+        num_upd = self._add_accumulator("num_updates", param,
+                                        dtype="int32", shape=[1])
+        block.append_op(
+            "average_accumulates",
+            inputs={"param": param, "in_sum_1": s1, "in_sum_2": s2,
+                    "in_sum_3": s3, "in_num_accumulates": num_acc,
+                    "in_old_num_accumulates": old_num,
+                    "in_num_updates": num_upd},
+            outputs={"out_sum_1": s1, "out_sum_2": s2, "out_sum_3": s3,
+                     "out_num_accumulates": num_acc,
+                     "out_old_num_accumulates": old_num,
+                     "out_num_updates": num_upd},
+            attrs={"average_window": float(self.average_window),
+                   "min_average_window": int(self.min_average_window),
+                   "max_average_window": int(self.max_average_window)})
+
+    @contextmanager
+    def apply(self, executor, need_restore=True):
+        """Swap averaged values into the parameters for the body of the
+        `with` block."""
+        executor.run(self.apply_program)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor):
+        executor.run(self.restore_program)
 
 
 # Short aliases matching fluid's public names.

@@ -415,3 +415,137 @@ def test_proximal_adagrad():
     ref = p - (0.1 / np.sqrt(m_out)) * g
     t.check_output({"ParamOut": ref.astype(np.float32),
                     "MomentOut": m_out.astype(np.float32)}, atol=1e-5)
+
+
+# -- fill / crop / minus / batch_size_like randoms / ctc_align --------------
+
+def test_fill_op():
+    t = OpTestHarness("fill", {},
+                      attrs={"shape": [2, 2], "dtype": "float32",
+                             "value": [1.0, 2.0, 3.0, 4.0]},
+                      out_slots=["Out"])
+    t.check_output({"Out": np.asarray([[1, 2], [3, 4]], np.float32)})
+
+
+def test_crop_to_shape_attr():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    t = OpTestHarness("crop", {"X": ("x", x)},
+                      attrs={"offsets": [1, 2], "shape": [2, 3]},
+                      out_slots=["Out"])
+    t.check_output({"Out": x[1:3, 2:5]})
+
+
+def test_minus_op():
+    x, y = _r((3,), 60), _r((3,), 61)
+    t = OpTestHarness("minus", {"X": ("x", x), "Y": ("y", y)},
+                      out_slots=["Out"])
+    t.check_output({"Out": x - y}, atol=1e-6)
+
+
+def test_uniform_random_batch_size_like():
+    ref = np.zeros((7, 3), np.float32)
+    t = OpTestHarness("uniform_random_batch_size_like",
+                      {"Input": ("in", ref)},
+                      attrs={"shape": [-1, 5], "min": 0.0, "max": 1.0,
+                             "dtype": "float32", "seed": 7},
+                      out_slots=["Out"])
+    out = np.asarray(t.run_forward()["Out"])
+    assert out.shape == (7, 5)
+    assert (out >= 0).all() and (out <= 1).all()
+
+
+def test_ctc_align_merge_and_blank():
+    ids = np.asarray([[0, 1, 1, 0, 2, 2, 0]], np.int32)[..., None]
+    t = OpTestHarness("ctc_align", {"Input": ("x", ids)},
+                      attrs={"blank": 0, "merge_repeated": True},
+                      out_slots=["Output"],
+                      out_dtypes={"Output": "int32"})
+    out = t.run_forward()["Output"]
+    data = np.asarray(getattr(out, "data", out)).reshape(-1)
+    # merged+deblanked: [1, 2]
+    assert data[0] == 1 and data[1] == 2
+
+
+def test_average_accumulates_window_close():
+    p = np.full((3,), 2.0, np.float32)
+    z = np.zeros((3,), np.float32)
+    c0 = np.zeros((1,), np.int32)
+    # min/max window 2: after the 2nd call the window closes
+    attrs = {"average_window": 1.0, "min_average_window": 2,
+             "max_average_window": 2}
+    def step(s1, s2, s3, na, ona, nu):
+        t = OpTestHarness("average_accumulates",
+                          {"param": ("p", p), "in_sum_1": ("s1", s1),
+                           "in_sum_2": ("s2", s2), "in_sum_3": ("s3", s3),
+                           "in_num_accumulates": ("na", na),
+                           "in_old_num_accumulates": ("ona", ona),
+                           "in_num_updates": ("nu", nu)},
+                          attrs=attrs,
+                          out_slots=["out_sum_1", "out_sum_2", "out_sum_3",
+                                     "out_num_accumulates",
+                                     "out_old_num_accumulates",
+                                     "out_num_updates"],
+                          out_dtypes={"out_num_accumulates": "int32",
+                                      "out_old_num_accumulates": "int32",
+                                      "out_num_updates": "int32"})
+        o = t.run_forward()
+        return [np.asarray(o[k]) for k in
+                ("out_sum_1", "out_sum_2", "out_sum_3",
+                 "out_num_accumulates", "out_old_num_accumulates",
+                 "out_num_updates")]
+    s1, s2, s3, na, ona, nu = step(z, z, z, c0, c0, c0)
+    np.testing.assert_allclose(s1, p)      # window open: sum_1 = p
+    assert na[0] == 1 and nu[0] == 1
+    s1, s2, s3, na, ona, nu = step(s1.astype(np.float32), s2, s3, na, ona,
+                                   nu)
+    # window closed: sum_3 holds 2 steps' worth, counters reset
+    np.testing.assert_allclose(s3, 2 * p)
+    np.testing.assert_allclose(s1, z)
+    assert na[0] == 0 and ona[0] == 2 and nu[0] == 2
+
+
+def test_model_average_apply_restore():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    pt.reset_default_programs(); pt.reset_global_scope()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square(pred - y))
+        opt = pt.optimizer.SGDOptimizer(learning_rate=0.1)
+        _, params_grads = opt.minimize(loss)
+        ma = pt.optimizer.ModelAverage(params_grads, 0.15,
+                                       min_average_window=2,
+                                       max_average_window=100)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xd = rng.randn(8, 4).astype(np.float32)
+    yd = rng.randn(8, 1).astype(np.float32)
+    for _ in range(5):
+        exe.run(main, feed={"x": xd, "y": yd}, fetch_list=[loss])
+    from paddle_tpu.core.scope import global_scope
+    pname = params_grads[0][0].name
+    before = np.array(global_scope().get(pname))
+    with ma.apply(exe):
+        averaged = np.array(global_scope().get(pname))
+        assert not np.allclose(averaged, before)
+    restored = np.array(global_scope().get(pname))
+    np.testing.assert_allclose(restored, before, atol=1e-6)
+
+
+def test_crop_default_offsets_and_runtime_offsets():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    # empty offsets attr -> crop at origin, NOT a silent no-op
+    t = OpTestHarness("crop", {"X": ("x", x)},
+                      attrs={"offsets": [], "shape": [2, 3]},
+                      out_slots=["Out"])
+    t.check_output({"Out": x[:2, :3]})
+    # runtime Offsets tensor overrides the attr
+    off = np.asarray([1, 2], np.int32)
+    t2 = OpTestHarness("crop", {"X": ("x", x), "Offsets": ("o", off)},
+                       attrs={"offsets": [], "shape": [2, 3]},
+                       out_slots=["Out"])
+    t2.check_output({"Out": x[1:3, 2:5]})
