@@ -196,18 +196,29 @@ def increment_shared(x, value=1.0):
     return increment(x, value)
 
 
-def array_write(x, i, array=None):
+def array_write(x, i, array=None, capacity=None):
     """TensorArray write (reference: tensor_array_read_write_op.cc).
-    Arrays are dense [cap, ...] tensors with dynamic_update_slice."""
+    Arrays are dense [capacity, ...] tensors with dynamic_update_slice.
+    Writes back into the array var itself (reference in-place semantics)
+    so a write inside a While body carries the array through the loop.
+    `capacity` sizes a NEW array only — an existing array's capacity is
+    fixed at creation (writes past it clamp to the last slot)."""
     helper = LayerHelper("array_write")
+    inputs = {"X": x, "I": i}
+    attrs = {}
     if array is None:
         array = helper.create_tmp_variable(x.dtype)
         array.desc.type = "tensor_array"
-    out = helper.create_tmp_variable(x.dtype)
-    helper.append_op(type="array_write",
-                     inputs={"X": x, "I": i, "Array": array},
-                     outputs={"Out": out})
-    return out
+        attrs["capacity"] = capacity if capacity is not None else 128
+    else:
+        if capacity is not None:
+            raise ValueError(
+                "array_write: capacity only applies when creating a new "
+                "array; this array's capacity was fixed at creation")
+        inputs["Array"] = array
+    helper.append_op(type="array_write", inputs=inputs,
+                     outputs={"Out": array}, attrs=attrs)
+    return array
 
 
 def array_read(array, i):
@@ -226,9 +237,11 @@ def array_length(array):
     return out
 
 
-def less_than_v(x, y):
+def less_than_v(x, y, cond=None):
+    """cond= writes the result into an existing var — the book-test idiom
+    for refreshing a While condition inside the loop body."""
     helper = LayerHelper("less_than")
-    out = helper.create_tmp_variable("bool")
+    out = cond if cond is not None else helper.create_tmp_variable("bool")
     helper.append_op(type="less_than", inputs={"X": x, "Y": y},
                      outputs={"Out": out})
     return out

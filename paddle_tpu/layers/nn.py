@@ -924,29 +924,55 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
 
 
 def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
-                level=0):
+                level=0, is_accumulated=False):
     """One beam-search expansion step (reference: beam_search_op.cc),
-    fixed-beam dense form: scores [batch*beam, V]."""
+    fixed-beam dense form: scores [batch, beam, cand] (or flat
+    [batch*beam, cand]) step log-probs; totals accumulate against
+    pre_scores unless `is_accumulated`. Finished lanes (pre_id ==
+    end_id) are frozen instead of pruned — see ops/beam_search_ops.py.
+    Initialize pre_scores to 0 for lane 0 and a large negative value
+    for other lanes so identical initial beams don't duplicate."""
     helper = LayerHelper("beam_search")
-    selected_ids = helper.create_tmp_variable("int64")
-    selected_scores = helper.create_tmp_variable("float32")
-    parent_idx = helper.create_tmp_variable("int64")
+    selected_ids = helper.create_tmp_variable(ids.dtype)
+    selected_scores = helper.create_tmp_variable(scores.dtype)
+    parent_idx = helper.create_tmp_variable("int32")
+    inputs = {"pre_ids": pre_ids, "ids": ids, "scores": scores}
+    if pre_scores is not None:
+        inputs["pre_scores"] = pre_scores
     helper.append_op(type="beam_search",
-                     inputs={"pre_ids": pre_ids, "pre_scores": pre_scores,
-                             "ids": ids, "scores": scores},
+                     inputs=inputs,
                      outputs={"selected_ids": selected_ids,
                               "selected_scores": selected_scores,
                               "parent_idx": parent_idx},
-                     attrs={"beam_size": beam_size, "end_id": end_id})
+                     attrs={"beam_size": beam_size, "end_id": end_id,
+                            "is_accumulated": is_accumulated})
     return selected_ids, selected_scores, parent_idx
 
 
-def beam_search_decode(ids, scores, beam_size, end_id):
+def beam_search_decode(ids, scores, beam_size, end_id, parents=None,
+                       length=None):
+    """Backtrack beam-search step arrays into sentences (reference:
+    beam_search_decode_op.cc). `ids`/`scores`/`parents` are the stacked
+    step arrays ([T, ...]); `length` the valid-step count. Outputs
+    SentenceIds [batch, beam, T] (end_id padded) + SentenceScores
+    [batch, beam], best beam first. When `length` is omitted the FULL
+    array capacity is decoded — only correct for exactly-sized arrays;
+    loop-built arrays must pass their step counter."""
+    if parents is not None and length is None:
+        raise ValueError(
+            "beam_search_decode: parents implies a decode loop whose "
+            "arrays are capacity-padded; pass length= (the step counter) "
+            "or unwritten slots would be decoded as real steps")
     helper = LayerHelper("beam_search_decode")
-    sentence_ids = helper.create_tmp_variable("int64")
-    sentence_scores = helper.create_tmp_variable("float32")
+    sentence_ids = helper.create_tmp_variable(ids.dtype)
+    sentence_scores = helper.create_tmp_variable(scores.dtype)
+    inputs = {"Ids": ids, "Scores": scores}
+    if parents is not None:
+        inputs["ParentIdx"] = parents
+    if length is not None:
+        inputs["Length"] = length
     helper.append_op(type="beam_search_decode",
-                     inputs={"Ids": ids, "Scores": scores},
+                     inputs=inputs,
                      outputs={"SentenceIds": sentence_ids,
                               "SentenceScores": sentence_scores},
                      attrs={"beam_size": beam_size, "end_id": end_id})

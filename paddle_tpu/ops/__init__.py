@@ -14,6 +14,7 @@ from . import metric_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
 from . import crf_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import beam_search_ops  # noqa: F401
 
 from ..core.registry import OpRegistry
 

@@ -1,0 +1,131 @@
+"""Beam search ops, TPU static-shape design.
+
+Reference parity: paddle/fluid/operators/beam_search_op.{h,cc} and
+beam_search_decode_op.cc. The reference keeps a variable number of live
+beams per source in two-level LoD tensors, prunes finished beams, and
+reconstructs sentences by matching LoD offsets. That shape-dynamic design
+cannot compile to one XLA program, so here every step keeps a FIXED
+[batch, beam] lane grid:
+
+- finished lanes (pre_id == end_id) are frozen: their only candidate is
+  (end_id, pre_score), so they ride along at constant score instead of
+  being pruned;
+- selection is one top_k over the [batch, beam*cand] flattened totals;
+- a parent_idx output records each selected lane's source lane, and
+  beam_search_decode walks parents backward through the step arrays —
+  replacing the reference's LoD-offset matching.
+
+Whole decode loops (While + array ops + these) trace into a single
+jitted program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _canon(pre_ids, pre_scores, ids, scores, beam_size):
+    """Normalize flat [B*K, ...] inputs to [B, K, ...]; return a flag to
+    restore the caller's convention on output."""
+    flat = ids.ndim == 2
+    if flat:
+        b = ids.shape[0] // beam_size
+        ids = ids.reshape(b, beam_size, -1)
+        scores = scores.reshape(b, beam_size, -1)
+    pre_ids = pre_ids.reshape(ids.shape[0], beam_size)
+    if pre_scores is not None:
+        pre_scores = pre_scores.reshape(ids.shape[0], beam_size)
+    return pre_ids, pre_scores, ids, scores, flat
+
+
+@register_op("beam_search", no_grad_slots=["pre_ids", "pre_scores", "ids",
+                                           "scores"])
+def _beam_search(ctx):
+    """One expansion step: totals = pre_scores + scores (or scores alone
+    when `is_accumulated`), frozen lanes for finished beams, one top_k
+    over beam*cand."""
+    k = int(ctx.attr("beam_size"))
+    end_id = int(ctx.attr("end_id"))
+    is_acc = ctx.attr("is_accumulated", False)
+    pre_ids, pre_scores, ids, scores, flat = _canon(
+        ctx.input("pre_ids"), ctx.input("pre_scores"),
+        ctx.input("ids"), ctx.input("scores"), k)
+    b, _, c = ids.shape
+    neg = jnp.asarray(jnp.finfo(scores.dtype).min / 2, scores.dtype)
+
+    if pre_scores is None:
+        totals = scores
+        # no cumulative score to freeze at — rank dead lanes last so
+        # they can never crowd out live hypotheses
+        frozen = jnp.full((b, k), neg, scores.dtype)
+    else:
+        totals = scores if is_acc else pre_scores[..., None] + scores
+        frozen = pre_scores
+    finished = pre_ids.astype(jnp.int32) == end_id
+    # finished lane -> exactly one candidate: (end_id, frozen score)
+    totals = jnp.where(finished[..., None], neg, totals)
+    totals = totals.at[..., 0].set(
+        jnp.where(finished, frozen, totals[..., 0]))
+    ids_eff = jnp.where(finished[..., None],
+                        jnp.asarray(end_id, ids.dtype), ids)
+
+    top_s, top_i = jax.lax.top_k(totals.reshape(b, k * c), k)
+    parent = (top_i // c).astype(jnp.int32)
+    sel_ids = jnp.take_along_axis(ids_eff.reshape(b, k * c), top_i, axis=1)
+
+    if flat:
+        sel_ids = sel_ids.reshape(b * k, 1)
+        top_s = top_s.reshape(b * k, 1)
+        parent = parent.reshape(b * k, 1)
+    ctx.set_output("selected_ids", sel_ids)
+    ctx.set_output("selected_scores", top_s)
+    ctx.set_output("parent_idx", parent)
+
+
+@register_op("beam_search_decode", no_grad_slots=["Ids", "Scores",
+                                                  "ParentIdx", "Length"])
+def _beam_search_decode(ctx):
+    """Backtrack the step arrays into final sequences: lane order at the
+    last valid step is already score-sorted (top_k), so walk parents
+    from there. Output SentenceIds [B, K, T] padded with end_id,
+    SentenceScores [B, K]."""
+    k = int(ctx.attr("beam_size"))
+    end_id = int(ctx.attr("end_id"))
+    ids = ctx.input("Ids")          # [T, B, K] or [T, B*K, 1]
+    scores = ctx.input("Scores")
+    parents = ctx.input("ParentIdx")
+    length = ctx.input("Length")    # scalar valid-step count (optional)
+    t_cap = ids.shape[0]
+    ids = ids.reshape(t_cap, -1, k)
+    scores = scores.reshape(t_cap, -1, k)
+    b = ids.shape[1]
+    if parents is None:
+        parents = jnp.tile(jnp.arange(k, dtype=jnp.int32)[None, None],
+                           (t_cap, b, 1))
+    else:
+        parents = parents.reshape(t_cap, -1, k).astype(jnp.int32)
+    n_valid = jnp.asarray(t_cap, jnp.int32) if length is None \
+        else length.reshape(()).astype(jnp.int32)
+
+    last = n_valid - 1
+    sent_scores = jax.lax.dynamic_index_in_dim(scores, last, 0,
+                                               keepdims=False)  # [B, K]
+    lane0 = jnp.tile(jnp.arange(k, dtype=jnp.int32)[None], (b, 1))
+
+    def step(lane, t):
+        valid = t < n_valid
+        ids_t = jax.lax.dynamic_index_in_dim(ids, t, 0, keepdims=False)
+        par_t = jax.lax.dynamic_index_in_dim(parents, t, 0, keepdims=False)
+        tok = jnp.take_along_axis(ids_t, lane, axis=1)
+        nxt = jnp.take_along_axis(par_t, lane, axis=1)
+        tok = jnp.where(valid, tok, jnp.asarray(end_id, tok.dtype))
+        nxt = jnp.where(valid, nxt, lane)
+        return nxt, tok
+
+    ts = jnp.arange(t_cap - 1, -1, -1, dtype=jnp.int32)
+    _, toks = jax.lax.scan(step, lane0, ts)          # [T, B, K] reversed
+    sent_ids = jnp.flip(toks, axis=0).transpose(1, 2, 0)  # [B, K, T]
+    ctx.set_output("SentenceIds", sent_ids)
+    ctx.set_output("SentenceScores", sent_scores)

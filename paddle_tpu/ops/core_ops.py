@@ -220,7 +220,10 @@ def _scale(ctx):
 
 @register_op("increment")
 def _increment(ctx):
-    ctx.set_output("Out", ctx.input("X") + ctx.attr("step", 1.0))
+    x = ctx.input("X")
+    # keep the carry dtype stable (int counters must stay int inside
+    # while loops)
+    ctx.set_output("Out", x + jnp.asarray(ctx.attr("step", 1.0), x.dtype))
 
 
 @register_op("shape")

@@ -1,0 +1,171 @@
+"""Beam search battery (reference: beam_search_op.cc,
+beam_search_decode_op.cc; static-lane TPU design in
+paddle_tpu/ops/beam_search_ops.py)."""
+import numpy as np
+
+from op_test import OpTestHarness
+
+NEG = -1e9
+
+
+def test_beam_search_step_selects_global_topk():
+    # B=1, K=2, C=3 candidates/lane. Cumulative totals:
+    # lane0 (pre 1.0): [1.5, 1.4, 1.3]; lane1 (pre 0.9): [1.45, 1.0, 0.9]
+    pre_ids = np.asarray([[3, 4]], np.int64)
+    pre_scores = np.asarray([[1.0, 0.9]], np.float32)
+    ids = np.asarray([[[10, 11, 12], [20, 21, 22]]], np.int64)
+    scores = np.asarray([[[0.5, 0.4, 0.3], [0.55, 0.1, 0.0]]], np.float32)
+    t = OpTestHarness("beam_search",
+                      {"pre_ids": ("pi", pre_ids),
+                       "pre_scores": ("ps", pre_scores),
+                       "ids": ("i", ids), "scores": ("s", scores)},
+                      attrs={"beam_size": 2, "end_id": 0},
+                      out_slots=["selected_ids", "selected_scores",
+                                 "parent_idx"],
+                      out_dtypes={"selected_ids": "int64",
+                                  "parent_idx": "int32"})
+    outs = t.run_forward()
+    np.testing.assert_array_equal(np.asarray(outs["selected_ids"])[0],
+                                  [10, 20])
+    np.testing.assert_allclose(np.asarray(outs["selected_scores"])[0],
+                               [1.5, 1.45], atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(outs["parent_idx"])[0],
+                                  [0, 1])
+
+
+def test_beam_search_frozen_finished_lane():
+    # lane0 already emitted end_id: it must survive at its frozen score
+    # and keep emitting end_id, not expand.
+    pre_ids = np.asarray([[0, 4]], np.int64)      # end_id = 0
+    pre_scores = np.asarray([[2.0, 1.0]], np.float32)
+    ids = np.asarray([[[5, 6], [7, 8]]], np.int64)
+    scores = np.asarray([[[0.9, 0.8], [0.5, 0.4]]], np.float32)
+    t = OpTestHarness("beam_search",
+                      {"pre_ids": ("pi", pre_ids),
+                       "pre_scores": ("ps", pre_scores),
+                       "ids": ("i", ids), "scores": ("s", scores)},
+                      attrs={"beam_size": 2, "end_id": 0},
+                      out_slots=["selected_ids", "selected_scores",
+                                 "parent_idx"],
+                      out_dtypes={"selected_ids": "int64",
+                                  "parent_idx": "int32"})
+    outs = t.run_forward()
+    # frozen lane total 2.0 beats live lane's best 1.5
+    np.testing.assert_array_equal(np.asarray(outs["selected_ids"])[0],
+                                  [0, 7])
+    np.testing.assert_allclose(np.asarray(outs["selected_scores"])[0],
+                               [2.0, 1.5], atol=1e-6)
+
+
+def test_beam_search_decode_backtrack():
+    # T=3, B=1, K=2. Step arrays built by hand:
+    # step0 (init): ids [[1, 1]] parents identity
+    # step1: lane0 took token 5 from parent 0; lane1 token 6 from parent 0
+    # step2: lane0 token 9 from parent 1; lane1 token 8 from parent 0
+    ids = np.asarray([[[1, 1]], [[5, 6]], [[9, 8]]], np.int64)
+    scores = np.asarray([[[0., 0.]], [[1., .9]], [[2., 1.8]]], np.float32)
+    parents = np.asarray([[[0, 1]], [[0, 0]], [[1, 0]]], np.int32)
+    t = OpTestHarness("beam_search_decode",
+                      {"Ids": ("i", ids), "Scores": ("s", scores),
+                       "ParentIdx": ("p", parents)},
+                      attrs={"beam_size": 2, "end_id": 0},
+                      out_slots=["SentenceIds", "SentenceScores"],
+                      out_dtypes={"SentenceIds": "int64"})
+    outs = t.run_forward()
+    sent = np.asarray(outs["SentenceIds"])[0]     # [K, T]
+    # best lane (0) at last step came from parent 1 -> tokens 1, 6, 9
+    np.testing.assert_array_equal(sent[0], [1, 6, 9])
+    # lane 1 came from parent 0 -> tokens 1, 5, 8
+    np.testing.assert_array_equal(sent[1], [1, 5, 8])
+    np.testing.assert_allclose(np.asarray(outs["SentenceScores"])[0],
+                               [2.0, 1.8])
+
+
+def test_beam_search_decode_respects_length():
+    ids = np.asarray([[[1, 1]], [[5, 6]], [[0, 0]]], np.int64)
+    scores = np.asarray([[[0., 0.]], [[1., .9]], [[0., 0.]]], np.float32)
+    parents = np.asarray([[[0, 1]], [[0, 0]], [[0, 1]]], np.int32)
+    length = np.asarray([2], np.int32)
+    t = OpTestHarness("beam_search_decode",
+                      {"Ids": ("i", ids), "Scores": ("s", scores),
+                       "ParentIdx": ("p", parents),
+                       "Length": ("l", length)},
+                      attrs={"beam_size": 2, "end_id": 7},
+                      out_slots=["SentenceIds", "SentenceScores"],
+                      out_dtypes={"SentenceIds": "int64"})
+    outs = t.run_forward()
+    sent = np.asarray(outs["SentenceIds"])[0]
+    # only 2 valid steps; step 3 padded with end_id 7
+    np.testing.assert_array_equal(sent[0], [1, 5, 7])
+    np.testing.assert_allclose(np.asarray(outs["SentenceScores"])[0],
+                               [1.0, 0.9])
+
+
+def test_beam_search_full_decode_loop():
+    """End-to-end beam decode as a While program, book-test style
+    (reference: test_machine_translation.py:100-145): array_read the
+    previous step, expand with topk over a transition "LM", beam_search,
+    array_write the selections, then beam_search_decode the arrays."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.layers import control_flow as cf
+
+    V, K, T_MAX, END = 5, 2, 4, 0
+    # hand-crafted "LM": from token v the best next token is (v+1) % V;
+    # after token 3 the best next is END. Rows are log-prob-ish scores.
+    trans = np.full((V, V), -5.0, np.float32)
+    for v in range(V):
+        trans[v, (v + 1) % V] = -0.1
+    trans[3, 0] = 0.0       # after 3, end
+    trans[3, 4] = -4.0
+
+    pt.reset_default_programs(); pt.reset_global_scope()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        tr = layers.data("trans", [V, V], append_batch_size=False,
+                         dtype="float32")
+        init_ids = layers.data("init_ids", [1, K], append_batch_size=False,
+                               dtype="int64")
+        init_scores = layers.data("init_scores", [1, K],
+                                  append_batch_size=False, dtype="float32")
+        counter = layers.fill_constant([1], "int64", 0)
+        limit = layers.fill_constant([1], "int64", T_MAX - 1)
+        ids_arr = cf.array_write(init_ids, i=counter, capacity=T_MAX)
+        score_arr = cf.array_write(init_scores, i=counter, capacity=T_MAX)
+        parent_arr = cf.array_write(
+            layers.fill_constant([1, K], "int32", 0), i=counter,
+            capacity=T_MAX)
+        cond = cf.less_than_v(counter, limit)
+        w = cf.While(cond)
+        with w.block():
+            pre_ids = cf.array_read(ids_arr, counter)       # [1, K]
+            pre_scores = cf.array_read(score_arr, counter)
+            flat_ids = layers.reshape(pre_ids, [K])
+            logits = layers.gather(tr, flat_ids)            # [K, V]
+            logits3 = layers.reshape(logits, [1, K, V])
+            cand_scores, cand_ids = layers.topk(logits3, k=3)
+            sel_ids, sel_scores, parent = layers.beam_search(
+                pre_ids, pre_scores, cand_ids, cand_scores,
+                beam_size=K, end_id=END)
+            layers.increment(counter, value=1.0, in_place=True)
+            cf.array_write(sel_ids, i=counter, array=ids_arr)
+            cf.array_write(sel_scores, i=counter, array=score_arr)
+            cf.array_write(parent, i=counter, array=parent_arr)
+            cf.less_than_v(counter, limit, cond=cond)
+        length = layers.increment(counter, value=1.0, in_place=False)
+        sent_ids, sent_scores = layers.beam_search_decode(
+            ids_arr, score_arr, beam_size=K, end_id=END,
+            parents=parent_arr, length=length)
+    exe = pt.Executor()
+    exe.run(startup)
+    iid = np.asarray([[1, 1]], np.int64)
+    isc = np.asarray([[0.0, NEG]], np.float32)
+    out_ids, out_scores = exe.run(
+        main, feed={"trans": trans, "init_ids": iid, "init_scores": isc},
+        fetch_list=[sent_ids, sent_scores])
+    best = np.asarray(out_ids)[0, 0]
+    # best path from 1: 1 -> 2 -> 3 -> 0(end)
+    np.testing.assert_array_equal(best, [1, 2, 3, 0])
+    # best cumulative score: -0.1 + -0.1 + 0.0
+    np.testing.assert_allclose(float(np.asarray(out_scores)[0, 0]), -0.2,
+                               atol=1e-5)
