@@ -71,6 +71,43 @@ class _GradAccumulator:
                                   lod_level=fwd.lod_level)
 
 
+_SUB_BLOCK_ATTRS = ("sub_block_idx", "true_block_idx", "false_block_idx")
+
+
+def _sub_block_free_vars(op: OpDesc, block: BlockDesc) -> List[str]:
+    """Outer-block variables a sub-block op's body reads via closure (e.g.
+    fc parameters created inside a DynamicRNN/While/StaticRNN block).
+    These must become explicit __vjp__ inputs so gradients flow to them —
+    jax.vjp only differentiates w.r.t. function arguments."""
+    idxs = [op.attrs.get(a) for a in _SUB_BLOCK_ATTRS
+            if isinstance(op.attrs.get(a), int)]
+    if not idxs:
+        return []
+    program = block.program
+    free: List[str] = []
+    seen = set(op.input_names())
+
+    def visit(blk: BlockDesc):
+        local = set(blk.vars)
+        for sub_op in blk.ops:
+            for n in sub_op.input_names():
+                if n in local or n in seen:
+                    continue
+                seen.add(n)
+                if block.find_var_recursive(n) is not None:
+                    free.append(n)
+            for a in _SUB_BLOCK_ATTRS:
+                v = sub_op.attrs.get(a)
+                if isinstance(v, int) and 0 <= v < len(program.blocks):
+                    visit(program.blocks[v])
+            # names written by body ops are block-local for later ops
+            local.update(sub_op.output_names())
+
+    for idx in idxs:
+        visit(program.blocks[idx])
+    return free
+
+
 def _generic_grad_op(op: OpDesc, block: BlockDesc, acc: _GradAccumulator,
                      no_grad: Set[str]) -> Optional[OpDesc]:
     """Build the generic vjp-based grad op for `op`. Returns None if no input
@@ -81,6 +118,9 @@ def _generic_grad_op(op: OpDesc, block: BlockDesc, acc: _GradAccumulator,
     for slot, names in op.inputs.items():
         for n in names:
             fwd_in_entries.append((slot, n))
+    closure_names = _sub_block_free_vars(op, block)
+    for n in closure_names:
+        fwd_in_entries.append(("__closure__", n))
     fwd_out_names = op.output_names()
 
     out_has_grad = [acc.has(n) for n in fwd_out_names]
@@ -96,8 +136,27 @@ def _generic_grad_op(op: OpDesc, block: BlockDesc, acc: _GradAccumulator,
     if not any(in_need_grad):
         return None
 
+    if op.type == "while":
+        # lax.while_loop has no reverse-mode rule; the reference's
+        # WhileGrad (while_op.cc:96) replays step scopes — the scan-based
+        # equivalents are the trainable path here.
+        raise NotImplementedError(
+            "gradients through a While loop are not supported: use "
+            "DynamicRNN / StaticRNN (lax.scan-based, fully "
+            "differentiable) for trainable recurrences, or mark the "
+            "loop's inputs stop_gradient")
+
     out_grad_names = [acc.materialize(n)
                       for n, h in zip(fwd_out_names, out_has_grad) if h]
+
+    # In-place pattern (output aliases an input/closure name, e.g. While
+    # carries): the cotangent of the post-op value is consumed HERE; the
+    # pre-op value's grad is only what vjp produces below — drop the
+    # consumed contribution so it isn't double counted upstream.
+    in_name_set = {n for _, n in fwd_in_entries}
+    for n, h in zip(fwd_out_names, out_has_grad):
+        if h and n in in_name_set:
+            acc.contribs[n] = []
 
     grad_outputs: List[str] = []
     produced: Dict[str, str] = {}
@@ -123,7 +182,8 @@ def _generic_grad_op(op: OpDesc, block: BlockDesc, acc: _GradAccumulator,
         outputs={"InGrad": grad_outputs},
         attrs={"fwd_op": op.to_dict(),
                "out_has_grad": out_has_grad,
-               "in_need_grad": in_need_grad},
+               "in_need_grad": in_need_grad,
+               "closure_names": closure_names},
     )
     return gop
 

@@ -14,9 +14,9 @@ from typing import List, Optional
 from ..framework import Variable, default_main_program
 from ..layer_helper import LayerHelper
 
-__all__ = ["StaticRNN", "While", "Switch", "increment_shared",
-           "array_write", "array_read", "array_length", "less_than_v",
-           "cond_op"]
+__all__ = ["StaticRNN", "DynamicRNN", "IfElse", "While", "Switch",
+           "increment_shared", "array_write", "array_read", "array_length",
+           "less_than_v", "cond_op"]
 
 
 class StaticRNN:
@@ -55,11 +55,12 @@ class StaticRNN:
             self.rnn._entered = True
             return self.rnn
 
-        def __exit__(self, *exc):
+        def __exit__(self, exc_type, *exc):
             self.rnn._entered = False
             prog = self.rnn._parent_prog
             prog.rollback()
-            self.rnn._finalize()
+            if exc_type is None:
+                self.rnn._finalize()
             return False
 
     def step(self):
@@ -120,6 +121,218 @@ class StaticRNN:
                    "mem_pre_names": [v.name for v in self._mem_pre],
                    "mem_new_names": [v.name for v in self._mem_new],
                    "out_names": [o.name for o in self._outputs]})
+
+    def __call__(self):
+        res = self._result_vars
+        return res[0] if len(res) == 1 else res
+
+
+class DynamicRNN:
+    """Ragged-sequence RNN (reference: control_flow.py DynamicRNN:1354).
+
+    Usage parity with the reference:
+        drnn = DynamicRNN()
+        with drnn.block():
+            word = drnn.step_input(sentence)      # ragged [B, T, D]
+            prev = drnn.memory(shape=[H], value=0.0)   # or init=...
+            h = some_layers(word, prev)
+            drnn.update_memory(prev, h)
+            drnn.output(h)
+        out = drnn()          # ragged [B, T, H]
+
+    The reference shrinks the running batch as short sequences end
+    (lod_rank_table + shrink_rnn_memory); here the dense masked scan
+    freezes finished rows instead — see ops/control_flow_ops.py
+    dynamic_rnn."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self._inputs = []            # (outer ragged var, step var)
+        self._static = []
+        self._mem_init: List[Variable] = []
+        self._mem_pre: List[Variable] = []
+        self._mem_new: List[Optional[Variable]] = []
+        self._outputs: List[Variable] = []
+        self._block = None
+        self._parent_prog = None
+
+    class _Guard:
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            prog = default_main_program()
+            self.rnn._parent_prog = prog
+            self.rnn._block = prog.create_block()
+            return self.rnn
+
+        def __exit__(self, exc_type, *exc):
+            self.rnn._parent_prog.rollback()
+            if exc_type is None:
+                self.rnn._finalize()
+            return False
+
+    def block(self):
+        return DynamicRNN._Guard(self)
+
+    def step_input(self, x: Variable) -> Variable:
+        """x: ragged var (declared [batch, *feature] — the time axis is
+        implicit in lod_level=1 data); the per-step slice has the same
+        declared shape."""
+        sv = self._block.create_var(name=f"{x.name}@dstep",
+                                    shape=list(x.shape) if x.shape
+                                    else None, dtype=x.dtype)
+        self._inputs.append((x, sv))
+        return sv
+
+    def static_input(self, x: Variable) -> Variable:
+        """Non-sequence input visible unchanged at every step (closure
+        over the outer env — no slicing)."""
+        self._static.append(x)
+        return x
+
+    def memory(self, init: Variable = None, shape=None, value=0.0,
+               dtype="float32") -> Variable:
+        if init is None:
+            if not self._inputs:
+                raise ValueError("DynamicRNN.memory(shape=...) needs a "
+                                 "step_input first (for the batch size)")
+            prog = self._parent_prog
+            parent = prog.block(self._block.desc.parent_idx)
+            from ..framework import unique_name
+            ref = self._inputs[0][0]
+            init = parent.create_var(name=unique_name("drnn_mem_init"),
+                                     shape=[-1] + list(shape), dtype=dtype)
+            parent.append_op(
+                "fill_constant_batch_size_like",
+                inputs={"Input": ref}, outputs={"Out": init},
+                attrs={"shape": [-1] + list(shape), "dtype": dtype,
+                       "value": float(value), "input_dim_idx": 0,
+                       "output_dim_idx": 0})
+        pre = self._block.create_var(name=f"{init.name}@dpre",
+                                     shape=list(init.shape)
+                                     if init.shape else None,
+                                     dtype=init.dtype)
+        self._mem_init.append(init)
+        self._mem_pre.append(pre)
+        self._mem_new.append(None)
+        return pre
+
+    def update_memory(self, pre: Variable, new: Variable):
+        self._mem_new[self._mem_pre.index(pre)] = new
+
+    def output(self, *outputs):
+        self._outputs.extend(outputs)
+
+    def _finalize(self):
+        for i, new in enumerate(self._mem_new):
+            if new is None:
+                raise ValueError(
+                    f"DynamicRNN memory #{i} "
+                    f"({self._mem_pre[i].name!r}) was declared but "
+                    "update_memory() was never called for it")
+        helper = self.helper
+        self._result_vars = [
+            helper.create_tmp_variable(o.dtype, lod_level=1)
+            for o in self._outputs]
+        self._last_mem_vars = [
+            helper.create_tmp_variable(m.dtype, shape=list(m.shape)
+                                       if m.shape else None)
+            for m in self._mem_init]
+        helper.append_op(
+            type="dynamic_rnn",
+            inputs={"X": [x for x, _ in self._inputs],
+                    "MemInit": self._mem_init},
+            outputs={"Out": self._result_vars,
+                     "LastMem": self._last_mem_vars},
+            attrs={"sub_block_idx": self._block.idx,
+                   "step_in_names": [sv.name for _, sv in self._inputs],
+                   "mem_pre_names": [v.name for v in self._mem_pre],
+                   "mem_new_names": [v.name for v in self._mem_new],
+                   "out_names": [o.name for o in self._outputs]})
+
+    def __call__(self):
+        res = self._result_vars
+        return res[0] if len(res) == 1 else res
+
+    def last_memory(self, idx=0):
+        """Final memory value per sequence (reference users get this via
+        sequence_last_step; provided directly because the masked scan
+        already has it)."""
+        return self._last_mem_vars[idx]
+
+
+class IfElse:
+    """Row-wise conditional (reference: control_flow.py IfElse:1252).
+
+    with ie.true_block(): ... ie.output(t)
+    with ie.false_block(): ... ie.output(f)
+    out = ie()   # rows where cond from true branch, else false
+
+    Both branches run over the FULL batch and rows are merged by the
+    condition (dense TPU form of split/merge_lod_tensor)."""
+
+    def __init__(self, cond: Variable, name=None):
+        self.helper = LayerHelper("if_else", name=name)
+        self.cond = cond
+        self._blocks = {}        # "true"/"false" -> block
+        self._outs = {"true": [], "false": []}
+        self._active = None
+        self._prog = None
+
+    class _Branch:
+        def __init__(self, ie, which):
+            self.ie = ie
+            self.which = which
+
+        def __enter__(self):
+            prog = default_main_program()
+            self.ie._prog = prog
+            self.ie._blocks[self.which] = prog.create_block()
+            self.ie._active = self.which
+            return self.ie
+
+        def __exit__(self, exc_type, *exc):
+            self.ie._prog.rollback()
+            self.ie._active = None
+            if exc_type is None and "true" in self.ie._blocks \
+                    and "false" in self.ie._blocks:
+                self.ie._finalize()
+            return False
+
+    def true_block(self):
+        return IfElse._Branch(self, "true")
+
+    def false_block(self):
+        return IfElse._Branch(self, "false")
+
+    def input(self, x: Variable) -> Variable:
+        """Reference API shim: rows are not physically split in the
+        dense form, so the input is used as-is."""
+        return x
+
+    def output(self, *outs):
+        if self._active is None:
+            raise RuntimeError("IfElse.output() outside a branch block")
+        self._outs[self._active].extend(outs)
+
+    def _finalize(self):
+        t_outs, f_outs = self._outs["true"], self._outs["false"]
+        if len(t_outs) != len(f_outs):
+            raise ValueError("IfElse branches must output the same "
+                             f"number of vars ({len(t_outs)} vs "
+                             f"{len(f_outs)})")
+        helper = self.helper
+        self._result_vars = [helper.create_tmp_variable(o.dtype)
+                             for o in t_outs]
+        helper.append_op(
+            type="if_else",
+            inputs={"Cond": self.cond},
+            outputs={"Out": self._result_vars},
+            attrs={"true_block_idx": self._blocks["true"].idx,
+                   "false_block_idx": self._blocks["false"].idx,
+                   "true_out_names": [o.name for o in t_outs],
+                   "false_out_names": [o.name for o in f_outs]})
 
     def __call__(self):
         res = self._result_vars

@@ -124,3 +124,89 @@ def _array_read(ctx):
 def _array_length(ctx):
     arr = ctx.input("Array")
     ctx.set_output("Out", jnp.asarray(arr.shape[0], jnp.int64))
+
+
+@register_op_CF("dynamic_rnn")
+def _dynamic_rnn(ctx):
+    """Ragged-batch RNN (reference: DynamicRNN control_flow.py:1354 +
+    lod_rank_table/shrink_rnn_memory machinery). The reference shrinks
+    the live batch as short sequences finish; here the batch stays dense
+    [B, T, ...] and finished rows simply freeze their memory (masked
+    carry) — the TPU-native equivalent of shrink_rnn_memory. Outputs are
+    ragged (zero-masked past each row's length).
+
+    Contract (as in the reference, which rejects mismatched LoD): all
+    ragged step inputs share one set of lengths; the FIRST input's
+    lengths drive the masking. Mismatched lengths cannot be detected
+    inside the traced program and silently follow the first input."""
+    from ..core.lod import RaggedPair
+
+    xs_in = ctx.inputs("X")              # ragged step inputs
+    mem_init = ctx.inputs("MemInit")
+    step_in = ctx.attr("step_in_names")
+    mem_pre = ctx.attr("mem_pre_names")
+    mem_new = ctx.attr("mem_new_names")
+    out_names = ctx.attr("out_names")
+    blk_idx = ctx.attr("sub_block_idx")
+    outer = dict(ctx.env)
+
+    rags = []
+    for x in xs_in:
+        if isinstance(x, RaggedPair):
+            rags.append(x)
+        else:
+            rags.append(RaggedPair(
+                x, jnp.full((x.shape[0],), x.shape[1], jnp.int32)))
+    lengths = rags[0].lengths
+    t_max = rags[0].data.shape[1]
+    # time-major step data for scan
+    xs_tm = tuple(jnp.moveaxis(r.data, 1, 0) for r in rags)
+
+    def body(carry, inp):
+        t, x_t = inp
+        active = (t < lengths)           # [B]
+        env = dict(outer)
+        env.update(zip(mem_pre, carry))
+        env.update(zip(step_in, x_t))
+        env = _trace_sub(ctx, blk_idx, env)
+        new_carry = []
+        for old, name in zip(carry, mem_new):
+            new = env[name]
+            m = active.reshape((-1,) + (1,) * (new.ndim - 1))
+            new_carry.append(jnp.where(m, new, old))
+        outs = []
+        for n in out_names:
+            o = env[n]
+            m = active.reshape((-1,) + (1,) * (o.ndim - 1))
+            outs.append(jnp.where(m, o, jnp.zeros_like(o)))
+        return tuple(new_carry), tuple(outs)
+
+    ts = jnp.arange(t_max, dtype=jnp.int32)
+    final_mems, stacked = jax.lax.scan(body, tuple(mem_init), (ts, xs_tm))
+    outs = [RaggedPair(jnp.moveaxis(s, 0, 1), lengths) for s in stacked]
+    ctx.set_outputs("Out", outs)
+    ctx.set_outputs("LastMem", list(final_mems))
+
+
+@register_op_CF("if_else")
+def _if_else(ctx):
+    """Row-wise two-branch select (reference: IfElse control_flow.py:1252
+    over split_lod_tensor/merge_lod_tensor). The reference routes each
+    row to one branch's sub-executor; dense TPU form traces BOTH
+    branches over the full batch and merges rows by the condition —
+    compute is duplicated but stays one fused XLA program (the standard
+    accelerator trade)."""
+    cond = ctx.input("Cond")
+    outer = dict(ctx.env)
+    true_outs = ctx.attr("true_out_names")
+    false_outs = ctx.attr("false_out_names")
+
+    env_t = _trace_sub(ctx, ctx.attr("true_block_idx"), dict(outer))
+    env_f = _trace_sub(ctx, ctx.attr("false_block_idx"), dict(outer))
+    c = cond.reshape(-1).astype(jnp.bool_)
+    merged = []
+    for tn, fn in zip(true_outs, false_outs):
+        tv, fv = env_t[tn], env_f[fn]
+        m = c.reshape((-1,) + (1,) * (tv.ndim - 1))
+        merged.append(jnp.where(m, tv, fv))
+    ctx.set_outputs("Out", merged)

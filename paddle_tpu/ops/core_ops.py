@@ -249,15 +249,20 @@ def _vjp(ctx):
     out_grads = ctx.inputs("OutGrad")
     out_has_grad = ctx.attr("out_has_grad")
     in_need_grad = ctx.attr("in_need_grad")
+    # Sub-block ops read outer vars via closure (see backward.py
+    # _sub_block_free_vars); those ride along as extra FwdIn entries so
+    # jax.vjp sees them as arguments and produces their gradients.
+    closure_names = ctx.attr("closure_names", []) or []
     grad_out_names = [n for n, h in zip(fwd_out_names, out_has_grad) if h]
+    replay_names = fwd_in_names + list(closure_names)
 
     # Only grad-receiving outputs go through vjp (others contribute nothing),
     # and ragged values pass as their dense data (lengths are non-diff ints).
     from ..core.registry import run_op
 
     def f(vals):
-        env = {}
-        for n, v in zip(fwd_in_names, vals):
+        env = dict(ctx.env)
+        for n, v in zip(replay_names, vals):
             env[n] = v
         outs = run_op(fwd, env, ctx.extra)
         res = []

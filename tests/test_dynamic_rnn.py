@@ -1,0 +1,131 @@
+"""DynamicRNN + IfElse layer tests (reference: control_flow.py
+DynamicRNN:1354, IfElse:1252; TPU masked-scan design in
+ops/control_flow_ops.py)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.layers import control_flow as cf
+from paddle_tpu.core.lod import RaggedPair
+
+
+def _ragged(seqs, feat, max_len, dtype=np.float32):
+    b = len(seqs)
+    data = np.zeros((b, max_len, feat), dtype)
+    lens = np.zeros((b,), np.int32)
+    for i, s in enumerate(seqs):
+        arr = np.asarray(s, dtype).reshape(-1, feat)
+        data[i, :len(arr)] = arr
+        lens[i] = len(arr)
+    return RaggedPair(data, lens), data, lens
+
+
+def test_dynamic_rnn_masked_cumsum():
+    # running sum over ragged sequences; finished rows freeze memory
+    seqs = [[[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]],
+            [[10.0, 20.0]]]
+    rag, data, lens = _ragged(seqs, 2, 4)
+    pt.reset_default_programs(); pt.reset_global_scope()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [2], dtype="float32", lod_level=1)
+        drnn = cf.DynamicRNN()
+        with drnn.block():
+            w = drnn.step_input(x)
+            prev = drnn.memory(shape=[2], value=0.0)
+            s = w + prev
+            drnn.update_memory(prev, s)
+            drnn.output(s)
+        out = drnn()
+        last = drnn.last_memory()
+    exe = pt.Executor()
+    exe.run(startup)
+    o, lm = exe.run(main, feed={"x": rag}, fetch_list=[out, last])
+    # ragged fetches arrive as packed LoDTensors: valid steps
+    # concatenated, [sum(lens), feat]
+    od = np.asarray(o.data if hasattr(o, "data") else o)
+    expect = np.concatenate([np.cumsum(data[0, :3], axis=0),
+                             data[1, :1]])
+    np.testing.assert_allclose(od, expect)
+    # last_memory = total per sequence (frozen at each row's length)
+    lm = np.asarray(lm)
+    np.testing.assert_allclose(lm[0], data[0, :3].sum(0))
+    np.testing.assert_allclose(lm[1], [10, 20])
+
+
+def test_dynamic_rnn_trains():
+    # trainable step body (fc) — grads flow through the masked scan
+    rng = np.random.RandomState(0)
+    seqs = [rng.randn(int(n), 3).tolist() for n in [4, 2, 3]]
+    rag, _, _ = _ragged(seqs, 3, 5)
+    y = rng.randn(3, 4).astype(np.float32)
+    pt.reset_default_programs(); pt.reset_global_scope()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [3], dtype="float32", lod_level=1)
+        tgt = layers.data("tgt", [4], dtype="float32")
+        drnn = cf.DynamicRNN()
+        with drnn.block():
+            w = drnn.step_input(x)
+            prev = drnn.memory(shape=[4], value=0.0)
+            h = layers.fc(w, size=4, act="tanh")
+            nxt = h + prev
+            drnn.update_memory(prev, nxt)
+            drnn.output(nxt)
+        _ = drnn()
+        last = drnn.last_memory()
+        loss = layers.mean(layers.square(last - tgt))
+        pt.optimizer.AdamOptimizer(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    losses = []
+    for _ in range(25):
+        (lv,) = exe.run(main, feed={"x": rag, "tgt": y},
+                        fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_if_else_row_merge():
+    xv = np.asarray([[1.0], [-2.0], [3.0], [-4.0]], np.float32)
+    pt.reset_default_programs(); pt.reset_global_scope()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [1], dtype="float32")
+        zero = layers.fill_constant([1], "float32", 0.0)
+        from paddle_tpu.layers import ops as lops
+        cond = lops.greater_than(x, zero)
+        ie = cf.IfElse(cond)
+        with ie.true_block():
+            ie.output(ie.input(x) * 2.0)
+        with ie.false_block():
+            ie.output(ie.input(x) - 1.0)
+        out = ie()
+    exe = pt.Executor()
+    exe.run(startup)
+    (res,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(res),
+                               [[2.0], [-3.0], [6.0], [-5.0]])
+
+
+def test_while_gradient_raises_clearly():
+    import pytest
+    pt.reset_default_programs(); pt.reset_global_scope()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [2], dtype="float32")
+        x.desc.stop_gradient = False
+        s = layers.fc(x, size=2)
+        counter = layers.fill_constant([1], "int64", 0)
+        limit = layers.fill_constant([1], "int64", 3)
+        cond = cf.less_than_v(counter, limit)
+        w = cf.While(cond)
+        with w.block():
+            s2 = layers.elementwise_mul(s, s)
+            layers.assign_to(s2, s) if hasattr(layers, "assign_to") else \
+                layers.assign(s2, output=s)
+            layers.increment(counter, value=1.0, in_place=True)
+            cf.less_than_v(counter, limit, cond=cond)
+        loss = layers.mean(s)
+        with pytest.raises(NotImplementedError, match="While"):
+            pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
