@@ -110,3 +110,127 @@ class CompositeMetric(MetricBase):
 
     def eval(self):
         return [m.eval() for m in self._metrics]
+
+
+class DetectionMAP(MetricBase):
+    """Streaming detection mean-average-precision (reference:
+    evaluator.py DetectionMAP:254 + detection_map_op.cc). Host-side
+    accumulation like the other evaluators: update() per batch with the
+    static-shape NMS output of layers.detection_output plus ground
+    truth; eval() computes per-class AP ('integral' or '11point') and
+    returns the mean over classes with ground truth.
+
+    Matching per image/class (SSD/VOC protocol): detections sorted by
+    score; each takes its highest-IoU gt (matched or not). IoU >=
+    overlap_threshold and the gt unmatched -> TP; already matched -> FP
+    (no fallback to the next-best gt); below threshold -> FP. With
+    evaluate_difficult=False, difficult gts don't count toward npos and
+    detections whose best match is difficult are dropped (neither TP
+    nor FP)."""
+
+    def __init__(self, class_num, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral",
+                 background_label=0, name=None):
+        super().__init__(name)
+        assert ap_version in ("integral", "11point")
+        self.class_num = class_num
+        self.overlap_threshold = overlap_threshold
+        self.evaluate_difficult = evaluate_difficult
+        self.ap_version = ap_version
+        self.background_label = background_label
+        self.reset()
+
+    def reset(self):
+        # per class: npos (non-difficult gt count) and (score, is_tp) rows
+        self._npos = np.zeros(self.class_num, np.int64)
+        self._records = [[] for _ in range(self.class_num)]
+
+    @staticmethod
+    def _iou_matrix(a, b):
+        """[M, 4] x [N, 4] -> [M, N] IoU, vectorized on host."""
+        x1 = np.maximum(a[:, None, 0], b[None, :, 0])
+        y1 = np.maximum(a[:, None, 1], b[None, :, 1])
+        x2 = np.minimum(a[:, None, 2], b[None, :, 2])
+        y2 = np.minimum(a[:, None, 3], b[None, :, 3])
+        inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+        area = lambda v: np.maximum(v[:, 2] - v[:, 0], 0) * \
+            np.maximum(v[:, 3] - v[:, 1], 0)
+        union = area(a)[:, None] + area(b)[None, :] - inter
+        return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+    def update(self, detections, gt_boxes, gt_labels, difficult=None):
+        """One image. detections: [M, 6] rows (label, score, x1, y1, x2,
+        y2); padded rows (score < 0, as emitted by the static-shape NMS)
+        are ignored. gt_boxes: [N, 4]; gt_labels: [N]; difficult:
+        optional [N] bools."""
+        det = np.asarray(detections, np.float32).reshape(-1, 6)
+        det = det[det[:, 1] >= 0]
+        gtb = np.asarray(gt_boxes, np.float32).reshape(-1, 4)
+        gtl = np.asarray(gt_labels).reshape(-1).astype(np.int64)
+        diff = np.zeros(len(gtl), bool) if difficult is None \
+            else np.asarray(difficult).reshape(-1).astype(bool)
+        for c in range(self.class_num):
+            if c == self.background_label:
+                continue
+            sel = gtl == c
+            cls_gt = gtb[sel]
+            cls_diff = diff[sel]
+            if self.evaluate_difficult:
+                self._npos[c] += len(cls_gt)
+            else:
+                self._npos[c] += int((~cls_diff).sum())
+            cls_det = det[det[:, 0] == c]
+            order = np.argsort(-cls_det[:, 1])
+            matched = np.zeros(len(cls_gt), bool)
+            ious = self._iou_matrix(cls_det[:, 2:6], cls_gt) \
+                if len(cls_gt) else np.zeros((len(cls_det), 0))
+            for i in order:
+                score = cls_det[i, 1]
+                if ious.shape[1]:
+                    best_j = int(np.argmax(ious[i]))
+                    best = float(ious[i, best_j])
+                else:
+                    best, best_j = 0.0, -1
+                if best >= self.overlap_threshold and best_j >= 0:
+                    if not self.evaluate_difficult and cls_diff[best_j]:
+                        continue            # ignore: neither TP nor FP
+                    if not matched[best_j]:
+                        matched[best_j] = True
+                        self._records[c].append((score, 1))
+                    else:
+                        self._records[c].append((score, 0))
+                else:
+                    self._records[c].append((score, 0))
+
+    def _ap(self, recs, npos):
+        if npos == 0 or not recs:
+            return None
+        recs = sorted(recs, key=lambda r: -r[0])
+        tp = np.cumsum([r[1] for r in recs])
+        fp = np.cumsum([1 - r[1] for r in recs])
+        recall = tp / npos
+        precision = tp / np.maximum(tp + fp, 1e-12)
+        if self.ap_version == "11point":
+            ap = 0.0
+            # linspace, not arange: arange's 0.3/0.6/0.7 land a ulp high
+            # and would empty buckets whose max recall is exactly there
+            for t in np.linspace(0.0, 1.0, 11):
+                p = precision[recall >= t - 1e-9]
+                ap += (p.max() if len(p) else 0.0) / 11.0
+            return ap
+        # integral (VOC-style): sum precision deltas over recall steps
+        ap, prev_r = 0.0, 0.0
+        for r, p in zip(recall, precision):
+            ap += p * (r - prev_r)
+            prev_r = r
+        return ap
+
+    def eval(self):
+        aps = [self._ap(self._records[c], self._npos[c])
+               for c in range(self.class_num)
+               if c != self.background_label]
+        aps = [a for a in aps if a is not None]
+        return float(np.mean(aps)) if aps else 0.0
+
+
+__all__.append("DetectionMAP")

@@ -233,3 +233,41 @@ def test_detection_output_layer_end_to_end():
     # both priors far apart -> both kept, class 1 scores 0.9/0.8
     np.testing.assert_allclose(sorted(res[0, :, 1].tolist(), reverse=True),
                                [0.9, 0.8], atol=1e-6)
+
+
+def test_detection_map_metric():
+    from paddle_tpu.metrics import DetectionMAP
+    m = DetectionMAP(class_num=3, overlap_threshold=0.5,
+                     ap_version="integral")
+    # image: 2 gts of class 1; detections: one perfect match (TP at .9),
+    # one duplicate on the same gt (FP at .8), one off-target (FP at .7)
+    gt = np.asarray([[0, 0, 1, 1], [2, 2, 3, 3]], np.float32)
+    gl = np.asarray([1, 1])
+    det = np.asarray([
+        [1, 0.9, 0, 0, 1, 1],
+        [1, 0.8, 0.05, 0, 1, 1],       # IoU ~0.95 with gt0: duplicate
+        [1, 0.7, 5, 5, 6, 6],
+        [2, 0.6, 9, 9, 10, 10],        # class with no gt: excluded
+    ], np.float32)
+    m.update(det, gt, gl)
+    # class 1: recall steps .5 @ prec 1.0; AP = 1.0*0.5 = 0.5
+    np.testing.assert_allclose(m.eval(), 0.5, atol=1e-6)
+    # second image: detection matching the second gt lifts AP
+    m.update(np.asarray([[1, 0.95, 2, 2, 3, 3]], np.float32),
+             np.asarray([[2, 2, 3, 3]], np.float32), np.asarray([1]))
+    assert m.eval() > 0.5
+
+
+def test_detection_map_11point_and_difficult():
+    from paddle_tpu.metrics import DetectionMAP
+    m = DetectionMAP(class_num=2, ap_version="11point",
+                     evaluate_difficult=False, background_label=0)
+    gt = np.asarray([[0, 0, 1, 1], [2, 2, 3, 3]], np.float32)
+    gl = np.asarray([1, 1])
+    diff = np.asarray([False, True])
+    det = np.asarray([[1, 0.9, 0, 0, 1, 1],
+                      [1, 0.8, 2, 2, 3, 3]], np.float32)  # difficult match
+    m.update(det, gt, gl, difficult=diff)
+    # only 1 countable gt; its detection is TP; difficult match ignored
+    # 11point: recall 1.0 at precision 1.0 -> AP = 1.0
+    np.testing.assert_allclose(m.eval(), 1.0, atol=1e-6)
