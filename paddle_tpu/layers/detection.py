@@ -7,7 +7,8 @@ from __future__ import annotations
 from ..layer_helper import LayerHelper
 
 __all__ = ["prior_box", "box_coder", "iou_similarity", "bipartite_match",
-           "target_assign", "detection_output"]
+           "target_assign", "detection_output", "ssd_loss",
+           "multi_box_head"]
 
 
 def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
@@ -115,3 +116,91 @@ def detection_output(loc, scores, prior_box, prior_box_var,
                             "keep_top_k": keep_top_k,
                             "score_threshold": score_threshold})
     return out
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             normalize=True):
+    """SSD multibox loss (reference: detection.py ssd_loss:349). The
+    reference chains six LoD ops; here one fused op runs the whole
+    matching/mining/loss pipeline vmapped over the batch (see
+    ops/detection_ops.py ssd_loss). Ground truth is dense padded:
+    gt_box [N, G, 4], gt_label [N, G] with -1 marking absent rows —
+    the static-shape replacement for LoD gt. Returns per-image loss
+    [N, 1]."""
+    helper = LayerHelper("ssd_loss")
+    loss = helper.create_tmp_variable(location.dtype)
+    inputs = {"Location": location, "Confidence": confidence,
+              "GtBox": gt_box, "GtLabel": gt_label,
+              "PriorBox": prior_box}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = prior_box_var
+    helper.append_op(type="ssd_loss", inputs=inputs,
+                     outputs={"Loss": loss},
+                     attrs={"background_label": background_label,
+                            "overlap_threshold": overlap_threshold,
+                            "neg_pos_ratio": neg_pos_ratio,
+                            "neg_overlap": neg_overlap,
+                            "loc_loss_weight": loc_loss_weight,
+                            "conf_loss_weight": conf_loss_weight,
+                            "match_type": match_type,
+                            "normalize": normalize})
+    return loss
+
+
+def multi_box_head(inputs, image, num_classes, min_sizes, max_sizes=None,
+                   aspect_ratios=None, flip=True, clip=False,
+                   steps=None, offset=0.5,
+                   variance=(0.1, 0.1, 0.2, 0.2)):
+    """Per-feature-map loc/conf heads + concatenated priors (reference:
+    detection.py multi_box_head:567). For each input feature map i:
+    3x3 conv heads predict num_priors_i * 4 locations and
+    num_priors_i * num_classes confidences; priors come from prior_box.
+    Returns (mbox_loc [N, M, 4], mbox_conf [N, M, C], boxes [M, 4],
+    variances [M, 4])."""
+    from . import nn, tensor
+    if aspect_ratios is None:
+        aspect_ratios = [[1.0]] * len(inputs)
+    max_sizes = max_sizes or [None] * len(inputs)
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, feat in enumerate(inputs):
+        mins = min_sizes[i]
+        mins = mins if isinstance(mins, (list, tuple)) else [mins]
+        maxs = max_sizes[i]
+        if maxs is not None and not isinstance(maxs, (list, tuple)):
+            maxs = [maxs]
+        ars = aspect_ratios[i]
+        ars = list(ars) if isinstance(ars, (list, tuple)) else [ars]
+        step_i = (steps[i], steps[i]) if steps is not None else (0.0, 0.0)
+        box, var = prior_box(feat, image, min_sizes=mins, max_sizes=maxs,
+                             aspect_ratios=ars, flip=flip, clip=clip,
+                             variance=list(variance), offset=offset,
+                             steps=step_i)
+        # priors per cell = |expanded ars| * |mins| + |maxs|, using the
+        # op's OWN expansion so head channels always match prior counts
+        from ..ops.detection_ops import expand_aspect_ratios
+        n_ar = len(expand_aspect_ratios(ars, flip))
+        num_priors = n_ar * len(mins) + (len(maxs) if maxs else 0)
+        loc = nn.conv2d(feat, num_filters=num_priors * 4, filter_size=3,
+                        padding=1)
+        conf = nn.conv2d(feat, num_filters=num_priors * num_classes,
+                         filter_size=3, padding=1)
+        # [N, P*K, H, W] -> [N, H, W, P*K] -> [N, H*W*P, K]
+        loc = tensor.transpose(loc, [0, 2, 3, 1])
+        loc = tensor.reshape(loc, [0, -1, 4])
+        conf = tensor.transpose(conf, [0, 2, 3, 1])
+        conf = tensor.reshape(conf, [0, -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes_l.append(tensor.reshape(box, [-1, 4]))
+        vars_l.append(tensor.reshape(var, [-1, 4]))
+    mbox_loc = locs[0] if len(locs) == 1 else tensor.concat(locs, axis=1)
+    mbox_conf = confs[0] if len(confs) == 1 else \
+        tensor.concat(confs, axis=1)
+    boxes = boxes_l[0] if len(boxes_l) == 1 else \
+        tensor.concat(boxes_l, axis=0)
+    variances = vars_l[0] if len(vars_l) == 1 else \
+        tensor.concat(vars_l, axis=0)
+    return mbox_loc, mbox_conf, boxes, variances

@@ -8,3 +8,4 @@ from . import vgg  # noqa: F401
 from . import lstm_lm  # noqa: F401
 from . import transformer  # noqa: F401
 from . import deepfm  # noqa: F401
+from . import ssd  # noqa: F401

@@ -1,0 +1,67 @@
+"""SSD object detector (reference capability: the fluid detection op
+suite — detection.py ssd_loss/multi_box_head/detection_output — as
+exercised by models like MobileNet-SSD in the PaddlePaddle model zoo).
+
+Compact VGG-style backbone with two detection feature maps; training
+builds the fused ssd_loss (matching + hard-negative mining + smooth-L1 +
+softmax CE in one vmapped op), inference decodes with static-shape
+multiclass NMS. Ground truth feeds dense padded boxes/labels (-1 label =
+absent row) — the static-shape replacement for LoD gt."""
+from __future__ import annotations
+
+from .. import layers, nets, optimizer as opt
+from ..layers import detection as det
+
+
+def _backbone(img):
+    c1 = nets.img_conv_group(input=img, conv_num_filter=[32, 32],
+                             pool_size=2, pool_stride=2,
+                             conv_filter_size=3, conv_act="relu")
+    c2 = nets.img_conv_group(input=c1, conv_num_filter=[64, 64],
+                             pool_size=2, pool_stride=2,
+                             conv_filter_size=3, conv_act="relu")
+    c3 = nets.img_conv_group(input=c2, conv_num_filter=[128, 128],
+                             pool_size=2, pool_stride=2,
+                             conv_filter_size=3, conv_act="relu")
+    return c2, c3      # stride-4 and stride-8 feature maps
+
+
+def build_heads(img, num_classes, image_shape):
+    f1, f2 = _backbone(img)
+    s = image_shape[-1]
+    loc, conf, boxes, pvars = det.multi_box_head(
+        [f1, f2], img, num_classes,
+        min_sizes=[s * 0.1, s * 0.3],
+        max_sizes=[s * 0.3, s * 0.6],
+        aspect_ratios=[[1.0, 2.0], [1.0, 2.0]], flip=True, clip=True)
+    return loc, conf, boxes, pvars
+
+
+def build_train(num_classes=4, image_shape=(3, 64, 64), max_gt=8,
+                lr=1e-3):
+    import paddle_tpu as pt
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        img = layers.data("img", list(image_shape), dtype="float32")
+        gt_box = layers.data("gt_box", [max_gt, 4], dtype="float32")
+        gt_label = layers.data("gt_label", [max_gt], dtype="int64")
+        loc, conf, boxes, pvars = build_heads(img, num_classes,
+                                              image_shape)
+        loss_v = det.ssd_loss(loc, conf, gt_box, gt_label, boxes, pvars)
+        loss = layers.mean(loss_v)
+        opt.AdamOptimizer(learning_rate=lr).minimize(loss)
+    return main, startup, {"loss": loss, "loc": loc, "conf": conf}
+
+
+def build_infer(num_classes=4, image_shape=(3, 64, 64), keep_top_k=20):
+    import paddle_tpu as pt
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        img = layers.data("img", list(image_shape), dtype="float32")
+        loc, conf, boxes, pvars = build_heads(img, num_classes,
+                                              image_shape)
+        dets = det.detection_output(loc, conf, boxes, pvars,
+                                    nms_top_k=keep_top_k * 2,
+                                    keep_top_k=keep_top_k,
+                                    score_threshold=0.1)
+    return main, startup, {"detections": dets}

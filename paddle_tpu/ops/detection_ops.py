@@ -17,6 +17,48 @@ import numpy as np
 from ..core.registry import register_op
 
 
+def expand_aspect_ratios(ars, flip):
+    """Reference ExpandAspectRatios (prior_box_op.h:23-40): 1.0 first,
+    then each new ratio (+ its reciprocal when flip), deduplicated.
+    Shared with layers.detection.multi_box_head so head channel counts
+    always match the op's prior count."""
+    out = [1.0]
+    for a in ars:
+        if any(abs(a - e) < 1e-6 for e in out):
+            continue
+        out.append(float(a))
+        if flip:
+            out.append(1.0 / float(a))
+    return out
+
+
+def _greedy_match(dist, steps, min_valid):
+    """Greedy bipartite core shared by the bipartite_match op and the
+    fused ssd_loss: repeatedly take the globally-largest entry above
+    `min_valid`, retire its row and column. Bounded `steps` iterations —
+    static for XLA."""
+    m = dist.shape[1]
+
+    def body(_, state):
+        d, row_of_col, dist_of_col = state
+        flat = jnp.argmax(d)
+        i, j = flat // m, flat % m
+        best = d[i, j]
+        found = best > min_valid
+        row_of_col = jnp.where(found, row_of_col.at[j].set(i), row_of_col)
+        dist_of_col = jnp.where(found, dist_of_col.at[j].set(best),
+                                dist_of_col)
+        d = jnp.where(found, d.at[i, :].set(-jnp.inf), d)
+        d = jnp.where(found, d.at[:, j].set(-jnp.inf), d)
+        return d, row_of_col, dist_of_col
+
+    row0 = jnp.full((m,), -1, jnp.int32)
+    dist0 = jnp.zeros((m,), dist.dtype)
+    _, row, dist_out = jax.lax.fori_loop(0, steps, body,
+                                         (dist, row0, dist0))
+    return row, dist_out
+
+
 @register_op("prior_box", no_grad_slots=["Input", "Image"])
 def _prior_box(ctx):
     """SSD prior (anchor) boxes for one feature map (prior_box_op.cc).
@@ -40,15 +82,7 @@ def _prior_box(ctx):
     sw = step_w if step_w > 0 else iw / w
     sh = step_h if step_h > 0 else ih / h
 
-    # expanded aspect ratios as the reference does (1.0 first, then each
-    # ar (+ reciprocal when flip))
-    out_ars = [1.0]
-    for a in ars:
-        if any(abs(a - e) < 1e-6 for e in out_ars):
-            continue
-        out_ars.append(a)
-        if flip:
-            out_ars.append(1.0 / a)
+    out_ars = expand_aspect_ratios(ars, flip)
 
     # reference pairs max_sizes[i] with min_sizes[i]: per min size, one
     # prior per aspect ratio, then one square sqrt(min*max) prior
@@ -152,25 +186,7 @@ def _bipartite_match(ctx):
     min(N, M) steps — static for XLA."""
     dist = ctx.input("DistMat")  # [N, M] similarity (rows = gt, cols=prior)
     n, m = dist.shape
-    steps = min(n, m)
-
-    def body(k, state):
-        d, row_of_col, dist_of_col = state
-        flat = jnp.argmax(d)
-        i, j = flat // m, flat % m
-        best = d[i, j]
-        found = best > -jnp.inf
-        row_of_col = jnp.where(found, row_of_col.at[j].set(i), row_of_col)
-        dist_of_col = jnp.where(found, dist_of_col.at[j].set(best),
-                                dist_of_col)
-        d = jnp.where(found, d.at[i, :].set(-jnp.inf), d)
-        d = jnp.where(found, d.at[:, j].set(-jnp.inf), d)
-        return d, row_of_col, dist_of_col
-
-    row_of_col = jnp.full((m,), -1, jnp.int32)
-    dist_of_col = jnp.zeros((m,), dist.dtype)
-    _, row_of_col, dist_of_col = jax.lax.fori_loop(
-        0, steps, body, (dist, row_of_col, dist_of_col))
+    row_of_col, dist_of_col = _greedy_match(dist, min(n, m), -jnp.inf)
     match_type = ctx.attr("match_type", "bipartite")
     if match_type == "per_prediction":
         thr = ctx.attr("dist_threshold", 0.5)
@@ -294,3 +310,105 @@ def _multiclass_nms(ctx):
     outs, nums = jax.vmap(one_image)(bboxes, scores)
     ctx.set_output("Out", outs)
     ctx.set_output("NumDetections", nums)
+
+
+def _encode_boxes(gt, prior, pvar):
+    """Center-size encode gt [M, 4] (already gathered per prior) against
+    priors [M, 4] (box_coder encode semantics, normalized boxes)."""
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    tw = gt[:, 2] - gt[:, 0]
+    th = gt[:, 3] - gt[:, 1]
+    tcx = gt[:, 0] + tw / 2
+    tcy = gt[:, 1] + th / 2
+    ox = (tcx - pcx) / pw / pvar[:, 0]
+    oy = (tcy - pcy) / ph / pvar[:, 1]
+    ow = jnp.log(jnp.maximum(tw / pw, 1e-10)) / pvar[:, 2]
+    oh = jnp.log(jnp.maximum(th / ph, 1e-10)) / pvar[:, 3]
+    return jnp.stack([ox, oy, ow, oh], axis=-1)
+
+
+@register_op("ssd_loss", no_grad_slots=["GtBox", "GtLabel", "PriorBox",
+                                        "PriorBoxVar"])
+def _ssd_loss(ctx):
+    """Fused SSD multibox loss (reference: detection.py ssd_loss:349 —
+    which chains iou_similarity, bipartite_match, target_assign,
+    mine_hard_examples, box_coder, smooth_l1 as separate LoD ops per
+    batch). TPU-native form: the whole pipeline is one vmapped static-
+    shape rule, so XLA fuses matching, mining, and both losses into the
+    training step. Ground truth arrives padded [B, G, 4] / [B, G] with
+    label -1 marking absent rows (the dense replacement for LoD gt).
+    Output: per-image loss [B], normalized by max(num_pos, 1) when
+    `normalize`."""
+    loc = ctx.input("Location")        # [B, M, 4]
+    conf = ctx.input("Confidence")     # [B, M, C]
+    gt_box = ctx.input("GtBox")        # [B, G, 4]
+    gt_label = ctx.input("GtLabel")    # [B, G] int, -1 pad
+    prior = ctx.input("PriorBox")      # [M, 4]
+    pvar = ctx.input("PriorBoxVar")
+    if pvar is None:
+        pvar = jnp.ones_like(prior)
+    bg = int(ctx.attr("background_label", 0))
+    overlap_t = ctx.attr("overlap_threshold", 0.5)
+    ratio = ctx.attr("neg_pos_ratio", 3.0)
+    neg_overlap = ctx.attr("neg_overlap", 0.5)
+    loc_w = ctx.attr("loc_loss_weight", 1.0)
+    conf_w = ctx.attr("conf_loss_weight", 1.0)
+    match_type = ctx.attr("match_type", "per_prediction")
+    normalize = ctx.attr("normalize", True)
+    m = prior.shape[0]
+
+    if gt_label.ndim == 3 and gt_label.shape[-1] == 1:
+        gt_label = gt_label[..., 0]
+    gt_label = gt_label.astype(jnp.int32)
+
+    def one_image(loc_i, conf_i, gtb_i, gtl_i):
+        valid = gtl_i >= 0                                     # [G]
+        sim = _pairwise_iou(gtb_i, prior)                      # [G, M]
+        sim = jnp.where(valid[:, None], sim, -1.0)
+        # min_valid 0.0: padded gt rows (sim forced to -1) never match
+        match, match_dist = _greedy_match(sim, sim.shape[0], 0.0)
+        if match_type == "per_prediction":
+            best_row = jnp.argmax(sim, axis=0).astype(jnp.int32)
+            best_val = jnp.max(sim, axis=0)
+            extra = (match < 0) & (best_val > overlap_t)
+            match = jnp.where(extra, best_row, match)
+            match_dist = jnp.where(extra, best_val, match_dist)
+        matched = match >= 0                                   # [M]
+        safe = jnp.clip(match, 0, gtb_i.shape[0] - 1)
+
+        # conf loss per prior against current targets (for mining)
+        tgt_label = jnp.where(matched, gtl_i[safe], bg)
+        logp = jax.nn.log_softmax(conf_i, axis=-1)
+        ce = -jnp.take_along_axis(logp, tgt_label[:, None],
+                                  axis=1)[:, 0]                # [M]
+
+        # max_negative mining: top-loss unmatched priors whose best
+        # overlap is under neg_overlap
+        num_pos = matched.sum()
+        neg_cand = (~matched) & (jnp.max(sim, axis=0) < neg_overlap)
+        num_neg = jnp.minimum((num_pos * ratio).astype(jnp.int32),
+                              neg_cand.sum().astype(jnp.int32))
+        neg_loss = jnp.where(neg_cand, ce, -jnp.inf)
+        order = jnp.argsort(-neg_loss)
+        is_neg = jnp.zeros((m,), bool).at[order].set(
+            jnp.arange(m) < num_neg)
+        is_neg = is_neg & neg_cand
+
+        # localization loss on positives (smooth l1 on encoded deltas)
+        enc = _encode_boxes(gtb_i[safe], prior, pvar)          # [M, 4]
+        diff = loc_i - enc
+        ad = jnp.abs(diff)
+        sl1 = jnp.where(ad < 1.0, 0.5 * diff * diff, ad - 0.5).sum(-1)
+        loc_loss = (sl1 * matched).sum()
+
+        conf_loss = (ce * (matched | is_neg)).sum()
+        total = conf_w * conf_loss + loc_w * loc_loss
+        if normalize:
+            total = total / jnp.maximum(num_pos.astype(total.dtype), 1.0)
+        return total
+
+    loss = jax.vmap(one_image)(loc, conf, gt_box, gt_label)
+    ctx.set_output("Loss", loss[:, None])
