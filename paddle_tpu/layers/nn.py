@@ -77,9 +77,15 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
-              padding_idx=None, param_attr=None, dtype="float32"):
-    """Embedding lookup (reference: layers/nn.py:218). is_sparse selects the
-    SelectedRows-style sparse-gradient path (see parallel/sparse.py)."""
+              padding_idx=None, param_attr=None, dtype="float32",
+              shard_axis="model"):
+    """Embedding lookup (reference: layers/nn.py:218). is_distributed
+    row-shards the table over the mesh `shard_axis` and looks up via
+    shard_map + psum with row-sparse backward (parallel/sparse.py) —
+    the ICI replacement for the reference's pserver sparse path.
+    is_sparse is accepted for reference API parity only: on TPU the
+    single-chip gradient is a dense scatter-add XLA fuses into the
+    step, so the flag has no separate path here."""
     helper = LayerHelper("embedding", param_attr=param_attr)
     w = helper.create_parameter(helper.param_attr, shape=list(size),
                                 dtype=dtype)
@@ -87,6 +93,8 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     helper.append_op(type="lookup_table",
                      inputs={"W": w, "Ids": input}, outputs={"Out": out},
                      attrs={"is_sparse": is_sparse,
+                            "is_distributed": is_distributed,
+                            "shard_axis": shard_axis,
                             "padding_idx": -1 if padding_idx is None
                             else padding_idx})
     return out

@@ -378,13 +378,26 @@ def _kldiv_loss(ctx):
 @register_op("lookup_table", no_grad_slots=["Ids"])
 def _lookup_table(ctx):
     """Embedding lookup (reference: lookup_table_op.cc). Ids may carry a
-    trailing [.., 1] dim like the reference's LoDTensor ids."""
+    trailing [.., 1] dim like the reference's LoDTensor ids. With
+    is_distributed under an active mesh, the table is row-sharded and
+    gathered via shard_map + psum (parallel/sparse.py) — the ICI
+    replacement for the reference's pserver prefetch path."""
     w = ctx.input("W")
     ids = ctx.input("Ids")
     if ids.shape and ids.shape[-1] == 1:
         ids = ids.reshape(ids.shape[:-1])
     padding_idx = ctx.attr("padding_idx", -1)
-    out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    ids32 = ids.astype(jnp.int32)
+    if ctx.attr("is_distributed", False) and \
+            ctx.extra.get("mesh") is not None:
+        from ..parallel.sparse import sharded_lookup
+        out = sharded_lookup(w, ids32,
+                             axis=ctx.attr("shard_axis", "model"),
+                             mesh=ctx.extra["mesh"])
+    else:
+        # explicit clip: jnp.take's default OOB mode is NaN-fill, and
+        # the sharded path clips — keep the two paths identical
+        out = jnp.take(w, ids32, axis=0, mode="clip")
     if padding_idx is not None and padding_idx >= 0:
         mask = (ids != padding_idx)[..., None]
         out = out * mask.astype(out.dtype)

@@ -117,11 +117,20 @@ class ParallelExecutor(Executor):
             if best is not None:
                 sp = best[1]
                 val = scope.find(n)
-                if val is not None and hasattr(val, "shape") and \
-                        len(val.shape) == len(sp) and all(
-                            ax is None or val.shape[i] %
-                            mesh.shape[ax] == 0
-                            for i, ax in enumerate(sp)):
+                shape = None
+                if val is not None and hasattr(val, "shape"):
+                    shape = val.shape
+                else:
+                    # not in scope yet (e.g. startup initializing the
+                    # accumulator): use the declared var shape so the
+                    # very first write already lands sharded
+                    v = block.find_var_recursive(n)
+                    if v is not None and v.shape and \
+                            all(d and d > 0 for d in v.shape):
+                        shape = tuple(v.shape)
+                if shape is not None and len(shape) == len(sp) and all(
+                        ax is None or shape[i] % mesh.shape[ax] == 0
+                        for i, ax in enumerate(sp)):
                     return sp
             return self.sharding.default_param
 
