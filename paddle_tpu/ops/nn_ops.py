@@ -9,6 +9,8 @@ for the MXU internally, so parity costs nothing on TPU.
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -26,6 +28,13 @@ def _pair(v):
 
 # -- convolution ------------------------------------------------------------
 
+def _conv_nhwc():
+    """Read at trace time (not import) so in-process A/B toggling works.
+    A/B on real TPU showed NCHW ≥ NHWC (XLA's layout assignment already
+    re-tiles internally), so NCHW stays the default."""
+    return os.environ.get("PADDLE_TPU_CONV_LAYOUT", "nchw") == "nhwc"
+
+
 def _conv2d_impl(x, w, strides, paddings, dilations, groups):
     # Under AMP both operands drop to bf16; the MXU still accumulates in
     # f32 internally, so only the final rounding is bf16 — then cast back.
@@ -33,14 +42,25 @@ def _conv2d_impl(x, w, strides, paddings, dilations, groups):
     # transpose rule rejects mixed-dtype cotangents, so full-bf16 it is.)
     out_dtype = x.dtype
     x, w = amp_cast(x, w)
-    return jax.lax.conv_general_dilated(
+    nhwc = _conv_nhwc()
+    if nhwc:
+        # API stays NCHW; internally convs run NHWC. XLA cancels the
+        # transposes between consecutive convs, so the whole network
+        # effectively switches layout.
+        x = jnp.transpose(x, (0, 2, 3, 1))
+        w = jnp.transpose(w, (2, 3, 1, 0))
+    out = jax.lax.conv_general_dilated(
         x, w,
         window_strides=strides,
         padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
         rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(("NHWC", "HWIO", "NHWC") if nhwc
+                           else ("NCHW", "OIHW", "NCHW")),
         feature_group_count=groups,
-    ).astype(out_dtype)
+    )
+    if nhwc:
+        out = jnp.transpose(out, (0, 3, 1, 2))
+    return out.astype(out_dtype)
 
 
 @register_op("conv2d")
