@@ -64,19 +64,28 @@ def _cached_device_put(arr: np.ndarray):
     return dev
 
 
+def _maybe_cached(arr):
+    """Frozen owned ndarrays go through the device-side feed cache so a
+    repeated identical batch is uploaded once, not per step."""
+    if isinstance(arr, np.ndarray) and not arr.flags.writeable \
+            and arr.flags.owndata:
+        return _cached_device_put(arr)
+    return jnp.asarray(arr)
+
+
 def _to_device_value(value):
     """Convert a feed value (numpy / LoDTensor / scalar) to in-graph form."""
     if isinstance(value, RaggedPair):
-        return value
+        # cache ragged components too — otherwise every step re-uploads
+        # the padded batch over the host link
+        return RaggedPair(_maybe_cached(value.data),
+                          _maybe_cached(value.lengths))
     if isinstance(value, LoDTensor):
         if value.lod:
             padded, lengths = value.to_padded()
             return RaggedPair(jnp.asarray(padded), jnp.asarray(lengths))
         value = value.data
-    if isinstance(value, np.ndarray) and not value.flags.writeable \
-            and value.flags.owndata:
-        return _cached_device_put(value)
-    return jnp.asarray(value)
+    return _maybe_cached(value)
 
 
 def _to_host_value(value, return_numpy: bool):
