@@ -11,6 +11,8 @@ compiles one fused loop body instead of per-timestep kernel launches.
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -206,6 +208,18 @@ def _row_conv(ctx):
 
 # -- recurrent nets ---------------------------------------------------------
 
+def _rnn_unroll():
+    """Scan unroll factor, read at trace time (PADDLE_TPU_RNN_UNROLL,
+    1 disables). Unrolling amortizes loop overhead across the small
+    per-step recurrent matmuls; A/B on real TPU: unroll=4 ~ +30%
+    tokens/s on the LSTM-LM bench (unroll=8 regressed)."""
+    raw = os.environ.get("PADDLE_TPU_RNN_UNROLL", "4")
+    try:
+        return max(int(raw), 1)
+    except ValueError:
+        return 1 if raw.strip().lower() in ("off", "false", "no") else 4
+
+
 def _masked_scan_rnn(step, xs, init_states, lengths):
     """Run `step` over time axis 1 of xs, freezing state past each row's
     length. step(carry, x_t) -> (carry, out_t); carry is a tuple."""
@@ -230,7 +244,8 @@ def _masked_scan_rnn(step, xs, init_states, lengths):
         return carry, (masked if is_tuple else masked[0])
 
     xs_t = jnp.moveaxis(xs, 1, 0)  # [t, n, ...]
-    carry, outs = jax.lax.scan(body, init_states, (tpos, xs_t))
+    carry, outs = jax.lax.scan(body, init_states, (tpos, xs_t),
+                               unroll=_rnn_unroll())
     if isinstance(outs, tuple):
         return carry, tuple(jnp.moveaxis(o, 0, 1) for o in outs)
     return carry, jnp.moveaxis(outs, 0, 1)
