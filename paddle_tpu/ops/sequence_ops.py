@@ -217,7 +217,12 @@ def _rnn_unroll():
     try:
         return max(int(raw), 1)
     except ValueError:
-        return 1 if raw.strip().lower() in ("off", "false", "no") else 4
+        if raw.strip().lower() in ("off", "false", "no", "none",
+                                   "disabled", ""):
+            return 1
+        raise ValueError(
+            f"PADDLE_TPU_RNN_UNROLL={raw!r}: expected an integer or a "
+            "disable word (off/false/no/none/disabled)")
 
 
 def _masked_scan_rnn(step, xs, init_states, lengths):

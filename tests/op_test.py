@@ -37,9 +37,11 @@ class OpTestHarness:
 
     def __init__(self, op_type: str, inputs: Dict, attrs: Optional[Dict]
                  = None, out_slots: Sequence[str] = ("Out",),
-                 out_dtypes: Optional[Dict[str, str]] = None):
+                 out_dtypes: Optional[Dict[str, str]] = None,
+                 out_counts: Optional[Dict[str, int]] = None):
         self.op_type = op_type
         self.attrs = attrs or {}
+        self.out_counts = out_counts or {}
         self.inputs = {s: (v if isinstance(v, list) else [v])
                        for s, v in inputs.items()}
         self.out_slots = list(out_slots)
@@ -76,11 +78,15 @@ class OpTestHarness:
             out_vars = {}
             for slot in self.out_slots:
                 dtype = self.out_dtypes.get(slot, "float32")
-                out_vars[slot] = helper.create_tmp_variable(dtype)
+                n = self.out_counts.get(slot, 1)
+                out_vars[slot] = helper.create_tmp_variable(dtype) \
+                    if n == 1 else [helper.create_tmp_variable(dtype)
+                                    for _ in range(n)]
             helper.append_op(
                 type=self.op_type,
                 inputs={s: v for s, v in in_vars.items()},
-                outputs={s: [v] for s, v in out_vars.items()},
+                outputs={s: (v if isinstance(v, list) else [v])
+                         for s, v in out_vars.items()},
                 attrs=self.attrs)
         return main, startup, out_vars
 
@@ -94,10 +100,19 @@ class OpTestHarness:
     # -- forward ----------------------------------------------------------
     def _run_forward(self):
         if self._raw_outputs is None:
-            fetch = [self.out_vars[s] for s in self.out_slots]
+            fetch, spans = [], []
+            for s in self.out_slots:
+                v = self.out_vars[s]
+                vs = v if isinstance(v, list) else [v]
+                spans.append((s, len(vs), isinstance(v, list)))
+                fetch.extend(vs)
             outs = self.exe.run(self.main, feed=dict(self.feed),
                                 fetch_list=fetch, return_numpy=False)
-            self._raw_outputs = dict(zip(self.out_slots, outs))
+            res, i = {}, 0
+            for s, n, is_list in spans:
+                res[s] = list(outs[i:i + n]) if is_list else outs[i]
+                i += n
+            self._raw_outputs = res
         return self._raw_outputs
 
     def outputs(self) -> Dict[str, np.ndarray]:

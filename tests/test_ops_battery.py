@@ -1,0 +1,373 @@
+"""Broad op battery: direct NumPy-oracle + finite-difference coverage for
+ops that previously were only exercised indirectly through models
+(reference test strategy: one op_test per op, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+from op_test import OpTestHarness
+
+
+def _r(shape, seed, lo=-1.0, hi=1.0):
+    return np.random.RandomState(seed).uniform(lo, hi, shape).astype(
+        np.float32)
+
+
+# -- elementwise with reference axis-broadcast ------------------------------
+
+@pytest.mark.parametrize("op,fn", [
+    ("elementwise_sub", lambda a, b: a - b),
+    ("elementwise_mul", lambda a, b: a * b),
+    ("elementwise_div", lambda a, b: a / b),
+    ("elementwise_max", np.maximum),
+    ("elementwise_min", np.minimum),
+    ("elementwise_pow", lambda a, b: np.power(a, b)),
+])
+def test_elementwise_ops(op, fn):
+    a = _r((3, 4), 1, 0.5, 2.0)
+    b = _r((3, 4), 2, 0.5, 2.0)
+    t = OpTestHarness(op, {"X": ("x", a), "Y": ("y", b)},
+                      attrs={"axis": -1})
+    t.check_output({"Out": fn(a, b)}, atol=1e-5)
+
+
+def test_elementwise_add_axis_broadcast():
+    # reference broadcast: y [4] aligns at axis=1 of x [2, 4, 3]
+    x = _r((2, 4, 3), 3)
+    y = _r((4,), 4)
+    t = OpTestHarness("elementwise_add", {"X": ("x", x), "Y": ("y", y)},
+                      attrs={"axis": 1})
+    t.check_output({"Out": x + y.reshape(1, 4, 1)})
+    t.check_grad(["x", "y"])
+
+
+def test_elementwise_mul_grad():
+    x = _r((3, 4), 5, 0.5, 1.5)
+    y = _r((3, 4), 6, 0.5, 1.5)
+    t = OpTestHarness("elementwise_mul", {"X": ("x", x), "Y": ("y", y)},
+                      attrs={"axis": -1})
+    t.check_grad(["x", "y"])
+
+
+# -- activations ------------------------------------------------------------
+
+@pytest.mark.parametrize("op,fn", [
+    ("exp", np.exp),
+    ("log", lambda x: np.log(x)),
+    ("sqrt", np.sqrt),
+    ("rsqrt", lambda x: 1.0 / np.sqrt(x)),
+    ("square", np.square),
+    ("reciprocal", lambda x: 1.0 / x),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+    ("tanh_shrink", lambda x: x - np.tanh(x)),
+    ("softplus", lambda x: np.log1p(np.exp(x))),
+    ("softsign", lambda x: x / (1 + np.abs(x))),
+])
+def test_unary_ops(op, fn):
+    x = _r((4, 5), 7, 0.2, 2.0)
+    t = OpTestHarness(op, {"X": ("x", x)})
+    t.check_output({"Out": fn(x)}, atol=1e-5)
+
+
+@pytest.mark.parametrize("op", ["exp", "sigmoid", "square"])
+def test_unary_grads(op):
+    x = _r((3, 4), 8, 0.3, 1.2)
+    t = OpTestHarness(op, {"X": ("x", x)})
+    t.check_grad(["x"])
+
+
+def test_leaky_relu_and_elu():
+    x = _r((4, 4), 9, -2.0, 2.0)
+    t = OpTestHarness("leaky_relu", {"X": ("x", x)},
+                      attrs={"alpha": 0.1})
+    t.check_output({"Out": np.where(x > 0, x, 0.1 * x)})
+    t2 = OpTestHarness("elu", {"X": ("x", x)}, attrs={"alpha": 1.0})
+    t2.check_output({"Out": np.where(x > 0, x, np.expm1(x))}, atol=1e-5)
+
+
+def test_hard_sigmoid_swish_relu6():
+    x = _r((5,), 10, -4.0, 4.0)
+    t = OpTestHarness("relu6", {"X": ("x", x)})
+    t.check_output({"Out": np.clip(x, 0, 6)})
+    t2 = OpTestHarness("swish", {"X": ("x", x)}, attrs={"beta": 1.0})
+    t2.check_output({"Out": x / (1 + np.exp(-x))}, atol=1e-5)
+    t3 = OpTestHarness("hard_sigmoid", {"X": ("x", x)},
+                       attrs={"slope": 0.2, "offset": 0.5})
+    t3.check_output({"Out": np.clip(0.2 * x + 0.5, 0, 1)}, atol=1e-6)
+
+
+# -- reductions -------------------------------------------------------------
+
+@pytest.mark.parametrize("op,fn", [
+    ("reduce_sum", np.sum),
+    ("reduce_max", np.max),
+    ("reduce_min", np.min),
+    ("reduce_prod", np.prod),
+])
+def test_reduce_ops(op, fn):
+    x = _r((3, 4, 2), 11, 0.5, 1.5)
+    t = OpTestHarness(op, {"X": ("x", x)},
+                      attrs={"dim": [1], "keep_dim": False})
+    t.check_output({"Out": fn(x, axis=1)}, atol=1e-5)
+
+
+def test_reduce_sum_grad():
+    x = _r((3, 4), 12)
+    t = OpTestHarness("reduce_sum", {"X": ("x", x)},
+                      attrs={"dim": [0], "keep_dim": False})
+    t.check_grad(["x"])
+
+
+# -- shape ops --------------------------------------------------------------
+
+def test_reshape_transpose_squeeze_unsqueeze():
+    x = _r((2, 3, 4), 13)
+    t = OpTestHarness("reshape", {"X": ("x", x)}, attrs={"shape": [6, 4]})
+    t.check_output({"Out": x.reshape(6, 4)})
+    t2 = OpTestHarness("transpose", {"X": ("x", x)},
+                       attrs={"axis": [2, 0, 1]})
+    t2.check_output({"Out": x.transpose(2, 0, 1)})
+    t3 = OpTestHarness("unsqueeze", {"X": ("x", x)}, attrs={"axes": [0]})
+    t3.check_output({"Out": x[None]})
+    y = x[:1]
+    t4 = OpTestHarness("squeeze", {"X": ("y", y)}, attrs={"axes": [0]})
+    t4.check_output({"Out": y[0]})
+
+
+def test_concat_split_stack_unstack():
+    a, b = _r((2, 3), 14), _r((2, 3), 15)
+    t = OpTestHarness("concat", {"X": [("a", a), ("b", b)]},
+                      attrs={"axis": 0})
+    t.check_output({"Out": np.concatenate([a, b], axis=0)})
+    t2 = OpTestHarness("stack", {"X": [("a", a), ("b", b)]},
+                       attrs={"axis": 0}, out_slots=["Y"])
+    t2.check_output({"Y": np.stack([a, b])})
+    x = np.concatenate([a, b], axis=1)            # [2, 6]
+    t3 = OpTestHarness("split", {"X": ("x", x)},
+                       attrs={"axis": 1, "sections": [2, 4]},
+                       out_slots=["Out"], out_counts={"Out": 2})
+    outs = t3.run_forward()["Out"]
+    np.testing.assert_allclose(np.asarray(outs[0]), x[:, :2])
+    np.testing.assert_allclose(np.asarray(outs[1]), x[:, 2:])
+    t4 = OpTestHarness("unstack", {"X": ("a", a)}, attrs={"axis": 0},
+                       out_slots=["Y"], out_counts={"Y": 2})
+    uouts = t4.run_forward()["Y"]
+    np.testing.assert_allclose(np.asarray(uouts[1]), a[1])
+
+
+def test_expand_tile_reverse_roll():
+    x = _r((2, 3), 16)
+    t = OpTestHarness("expand", {"X": ("x", x)},
+                      attrs={"expand_times": [2, 1]})
+    t.check_output({"Out": np.tile(x, (2, 1))})
+    t_t = OpTestHarness("tile", {"X": ("x", x)},
+                        attrs={"repeat_times": [1, 2]})
+    t_t.check_output({"Out": np.tile(x, (1, 2))})
+    t2 = OpTestHarness("reverse", {"X": ("x", x)}, attrs={"axis": [1]})
+    t2.check_output({"Out": x[:, ::-1]})
+    t3 = OpTestHarness("roll", {"X": ("x", x)},
+                       attrs={"shifts": [1], "axis": [0]})
+    t3.check_output({"Out": np.roll(x, 1, axis=0)})
+
+
+def test_slice_strided_slice_pad():
+    x = _r((4, 5), 17)
+    t = OpTestHarness("slice", {"Input": ("x", x)},
+                      attrs={"axes": [0, 1], "starts": [1, 0],
+                             "ends": [3, 4]})
+    t.check_output({"Out": x[1:3, 0:4]})
+    t2 = OpTestHarness("pad", {"X": ("x", x)},
+                       attrs={"paddings": [1, 0, 0, 2],
+                              "pad_value": 0.5})
+    t2.check_output({"Out": np.pad(x, [(1, 0), (0, 2)],
+                                   constant_values=0.5)})
+    t3 = OpTestHarness("strided_slice", {"Input": ("x", x)},
+                       attrs={"axes": [1], "starts": [0], "ends": [5],
+                              "strides": [2]})
+    t3.check_output({"Out": x[:, 0:5:2]})
+
+
+def test_gather_scatter_where_masked_select():
+    x = _r((5, 3), 18)
+    idx = np.asarray([3, 0, 1], np.int64)
+    t = OpTestHarness("gather", {"X": ("x", x), "Index": ("i", idx)})
+    t.check_output({"Out": x[idx]})
+    cond = np.asarray([[True, False], [False, True]])
+    a, b = _r((2, 2), 19), _r((2, 2), 20)
+    t2 = OpTestHarness("where", {"Condition": ("c", cond), "X": ("a", a),
+                                 "Y": ("b", b)})
+    t2.check_output({"Out": np.where(cond, a, b)})
+    upd = _r((2, 3), 21)
+    sids = np.asarray([4, 1], np.int64)
+    t3 = OpTestHarness("scatter", {"X": ("x", x), "Ids": ("si", sids),
+                                   "Updates": ("u", upd)},
+                       attrs={"overwrite": True})
+    ref = x.copy(); ref[4], ref[1] = upd[0], upd[1]
+    t3.check_output({"Out": ref})
+    m = np.asarray([1, 0, 1, 0, 1], bool)[:, None] & np.ones((5, 3), bool)
+    t4 = OpTestHarness("masked_select", {"X": ("x", x), "Mask": ("m", m)},
+                       out_slots=["Out", "Count"],
+                       out_dtypes={"Count": "int32"})
+    mouts = t4.run_forward()
+    cnt = int(np.asarray(mouts["Count"]))
+    np.testing.assert_allclose(np.asarray(mouts["Out"]).reshape(-1)[:cnt],
+                               x[m].reshape(-1))
+
+
+# -- losses -----------------------------------------------------------------
+
+def test_square_error_cost():
+    x, y = _r((4, 1), 21), _r((4, 1), 22)
+    t = OpTestHarness("square_error_cost", {"X": ("x", x),
+                                            "Y": ("y", y)})
+    t.check_output({"Out": (x - y) ** 2}, atol=1e-6)
+
+
+def test_sigmoid_cross_entropy_with_logits():
+    x = _r((3, 4), 23, -2, 2)
+    lbl = np.random.RandomState(24).randint(0, 2, (3, 4)).astype(
+        np.float32)
+    t = OpTestHarness("sigmoid_cross_entropy_with_logits",
+                      {"X": ("x", x), "Label": ("l", lbl)})
+    sig = 1 / (1 + np.exp(-x))
+    ref = -(lbl * np.log(sig) + (1 - lbl) * np.log(1 - sig))
+    t.check_output({"Out": ref.astype(np.float32)}, atol=1e-5)
+    t.check_grad(["x"])
+
+
+def test_huber_and_hinge_loss():
+    x, y = _r((4, 1), 25), _r((4, 1), 26)
+    d = 1.0
+    r = y - x
+    ref = np.where(np.abs(r) <= d, 0.5 * r * r,
+                   d * (np.abs(r) - 0.5 * d))
+    t = OpTestHarness("huber_loss", {"X": ("x", x), "Y": ("y", y)},
+                      attrs={"delta": d})
+    t.check_output({"Out": ref.astype(np.float32)}, atol=1e-5)
+
+
+def test_log_loss_and_kldiv():
+    p = _r((4, 1), 27, 0.1, 0.9)
+    l = np.random.RandomState(28).randint(0, 2, (4, 1)).astype(np.float32)
+    eps = 1e-4
+    t = OpTestHarness("log_loss", {"Predicted": ("p", p),
+                                   "Labels": ("l", l)},
+                      attrs={"epsilon": eps}, out_slots=["Loss"])
+    ref = -l * np.log(p + eps) - (1 - l) * np.log(1 - p + eps)
+    t.check_output({"Loss": ref.astype(np.float32)}, atol=1e-5)
+    logp = np.log(_r((3, 4), 42, 0.1, 0.9))
+    tgt = _r((3, 4), 43, 0.1, 0.9)
+    t2 = OpTestHarness("kldiv_loss", {"X": ("lp", logp),
+                                      "Target": ("t", tgt)},
+                       attrs={"reduction": "mean"}, out_slots=["Loss"])
+    kref = np.mean(tgt * (np.log(np.maximum(tgt, 1e-12)) - logp))
+    t2.check_output({"Loss": np.float32(kref)}, atol=1e-5)
+
+
+def test_cos_sim_and_dot():
+    a, b = _r((3, 4), 29), _r((3, 4), 30)
+    t = OpTestHarness("dot", {"X": ("a", a), "Y": ("b", b)})
+    t.check_output({"Out": (a * b).sum(-1, keepdims=True)}, atol=1e-5)
+    t2 = OpTestHarness("cos_sim", {"X": ("a", a), "Y": ("b", b)})
+    cref = (a * b).sum(-1, keepdims=True) / (
+        np.linalg.norm(a, axis=-1, keepdims=True) *
+        np.linalg.norm(b, axis=-1, keepdims=True) + 1e-12)
+    t2.check_output({"Out": cref.astype(np.float32)}, atol=1e-5)
+
+
+# -- normalization / conv extras -------------------------------------------
+
+def test_l2_normalize():
+    x = _r((3, 4), 31, 0.1, 1.0)
+    t = OpTestHarness("l2_normalize", {"X": ("x", x)},
+                      attrs={"axis": 1, "epsilon": 1e-10})
+    ref = x / np.sqrt((x ** 2).sum(1, keepdims=True) + 1e-10)
+    t.check_output({"Out": ref.astype(np.float32)}, atol=1e-5)
+
+
+def test_conv2d_transpose_shape_and_grad():
+    x = _r((1, 2, 4, 4), 32)
+    w = _r((2, 3, 3, 3), 33)   # [in_c, out_c, kh, kw]
+    t = OpTestHarness("conv2d_transpose",
+                      {"Input": ("x", x), "Filter": ("w", w)},
+                      attrs={"strides": [2, 2], "paddings": [1, 1],
+                             "dilations": [1, 1]},
+                      out_slots=["Output"])
+    out = np.asarray(t.run_forward()["Output"])
+    # (i-1)*s - 2p + k = 3*2 - 2 + 3 = 7
+    assert out.shape == (1, 3, 7, 7)
+    t.check_grad(["x", "w"], output_slot="Output")
+
+
+def test_maxout():
+    x = _r((2, 4, 3, 3), 34)
+    t = OpTestHarness("maxout", {"X": ("x", x)}, attrs={"groups": 2})
+    ref = x.reshape(2, 2, 2, 3, 3).max(axis=2)
+    t.check_output({"Out": ref})
+
+
+def test_lrn_matches_formula():
+    x = _r((1, 6, 2, 2), 35, 0.1, 1.0)
+    n, alpha, beta, k = 5, 1e-4, 0.75, 1.0
+    t = OpTestHarness("lrn", {"X": ("x", x)},
+                      attrs={"n": n, "alpha": alpha, "beta": beta,
+                             "k": k})
+    sq = np.zeros_like(x)
+    half = n // 2
+    for c in range(6):
+        lo, hi = max(0, c - half), min(6, c + half + 1)
+        sq[:, c] = (x[:, lo:hi] ** 2).sum(axis=1)
+    ref = x / (k + alpha * sq) ** beta
+    t.check_output({"Out": ref.astype(np.float32)}, atol=1e-5)
+
+
+# -- misc -------------------------------------------------------------------
+
+def test_cumsum_variants():
+    x = _r((3, 4), 36)
+    t = OpTestHarness("cumsum", {"X": ("x", x)}, attrs={"axis": 1})
+    t.check_output({"Out": np.cumsum(x, axis=1)}, atol=1e-5)
+    t2 = OpTestHarness("cumsum", {"X": ("x", x)},
+                       attrs={"axis": 1, "reverse": True})
+    t2.check_output({"Out": np.cumsum(x[:, ::-1], axis=1)[:, ::-1]},
+                    atol=1e-5)
+
+
+def test_one_hot_and_argminmax():
+    ids = np.asarray([[1], [3], [0]], np.int64)
+    t = OpTestHarness("one_hot", {"X": ("x", ids)}, attrs={"depth": 4})
+    t.check_output({"Out": np.eye(4, dtype=np.float32)[ids.ravel()]})
+    x = _r((3, 4), 37)
+    t2 = OpTestHarness("arg_max", {"X": ("x", x)}, attrs={"axis": 1},
+                       out_dtypes={"Out": "int32"})
+    t2.check_output({"Out": x.argmax(1).astype(np.int32)})
+
+
+def test_clip_by_norm_and_sign():
+    x = _r((4,), 38, -2, 2)
+    t = OpTestHarness("sign", {"X": ("x", x)})
+    t.check_output({"Out": np.sign(x)})
+    n = np.linalg.norm(x)
+    t2 = OpTestHarness("clip_by_norm", {"X": ("x", x)},
+                       attrs={"max_norm": 0.5})
+    t2.check_output({"Out": x * 0.5 / max(n, 0.5)}, atol=1e-5)
+
+
+def test_im2sequence():
+    x = _r((1, 1, 4, 4), 39)
+    t = OpTestHarness("im2sequence", {"X": ("x", x)},
+                      attrs={"kernels": [2, 2], "strides": [2, 2],
+                             "paddings": [0, 0, 0, 0]})
+    out = np.asarray(t.run_forward()["Out"])
+    # 2x2 patches of a 4x4 image = 4 patches of 4 values
+    assert out.reshape(-1, 4).shape == (4, 4)
+    np.testing.assert_allclose(out.reshape(-1, 4)[0],
+                               x[0, 0, :2, :2].ravel(), atol=1e-6)
+
+
+def test_smooth_l1_loss_op():
+    x, y = _r((4, 2), 40), _r((4, 2), 41)
+    t = OpTestHarness("smooth_l1_loss", {"X": ("x", x), "Y": ("y", y)})
+    d = x - y
+    ref = np.where(np.abs(d) < 1.0, 0.5 * d * d,
+                   np.abs(d) - 0.5).sum(-1, keepdims=True)
+    t.check_output({"Out": ref.astype(np.float32)}, atol=1e-5)
