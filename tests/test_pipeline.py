@@ -1,0 +1,115 @@
+"""GPipe-style pipeline parallelism on the 8-device CPU mesh
+(paddle_tpu/parallel/pipeline.py — beyond reference parity; the
+reference's closest capability is layer-device model parallelism)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.parallel import make_mesh
+from paddle_tpu.parallel.pipeline import (pipeline_apply,
+                                          split_microbatches,
+                                          merge_microbatches)
+
+
+def _stage_fn(params, x):
+    w, b = params
+    return jnp.tanh(x @ w + b)
+
+
+def _make_params(n_stages, d, seed=0):
+    rng = np.random.RandomState(seed)
+    ws = rng.randn(n_stages, d, d).astype(np.float32) * 0.5
+    bs = rng.randn(n_stages, d).astype(np.float32) * 0.1
+    return jnp.asarray(ws), jnp.asarray(bs)
+
+
+def test_pipeline_matches_sequential_forward():
+    mesh = make_mesh((4,), ("pipe",), devices=jax.devices()[:4])
+    d, n_micro, mb = 8, 6, 4
+    params = _make_params(4, d)
+    rng = np.random.RandomState(1)
+    x = rng.randn(n_micro * mb, d).astype(np.float32)
+    micro = split_microbatches(jnp.asarray(x), n_micro)
+    out = pipeline_apply(_stage_fn, params, micro, axis="pipe", mesh=mesh)
+    got = np.asarray(merge_microbatches(out))
+    # sequential reference
+    ref = x
+    for i in range(4):
+        ref = np.tanh(ref @ np.asarray(params[0][i]) +
+                      np.asarray(params[1][i]))
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_pipeline_is_differentiable_and_trains():
+    mesh = make_mesh((4,), ("pipe",), devices=jax.devices()[:4])
+    d, n_micro, mb = 8, 4, 4
+    params = _make_params(4, d, seed=2)
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(n_micro * mb, d).astype(np.float32))
+    y = jnp.asarray(rng.randn(n_micro * mb, d).astype(np.float32))
+    micro_x = split_microbatches(x, n_micro)
+
+    def loss_fn(params):
+        out = merge_microbatches(
+            pipeline_apply(_stage_fn, params, micro_x, axis="pipe",
+                           mesh=mesh))
+        return jnp.mean((out - y) ** 2)
+
+    # gradient correctness vs the sequential composition
+    def seq_loss(params):
+        h = x
+        for i in range(4):
+            h = jnp.tanh(h @ params[0][i] + params[1][i])
+        return jnp.mean((h - y) ** 2)
+
+    g_pipe = jax.grad(loss_fn)(params)
+    g_seq = jax.grad(seq_loss)(params)
+    for gp, gs in zip(jax.tree_util.tree_leaves(g_pipe),
+                      jax.tree_util.tree_leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gs),
+                                   atol=1e-4)
+
+    # and a few SGD steps actually reduce the loss
+    p = params
+    l0 = float(loss_fn(p))
+    step = jax.jit(lambda p: jax.tree_util.tree_map(
+        lambda a, g: a - 0.5 * g, p, jax.grad(loss_fn)(p)))
+    for _ in range(20):
+        p = step(p)
+        # sync per step: the CPU backend's collective rendezvous can
+        # deadlock under a deep async queue of permute programs
+        jax.block_until_ready(p)
+    assert float(loss_fn(p)) < l0 * 0.5
+
+
+def test_pipeline_composes_with_data_axis():
+    mesh = make_mesh((4, 2), ("pipe", "data"))
+    d, n_micro, mb = 4, 4, 4
+    params = _make_params(4, d, seed=4)
+    rng = np.random.RandomState(5)
+    x = rng.randn(n_micro * mb, d).astype(np.float32)
+    micro = split_microbatches(jnp.asarray(x), n_micro)
+    out = pipeline_apply(_stage_fn, params, micro, axis="pipe", mesh=mesh)
+    got = np.asarray(merge_microbatches(out))
+    ref = x
+    for i in range(4):
+        ref = np.tanh(ref @ np.asarray(params[0][i]) +
+                      np.asarray(params[1][i]))
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_pipeline_requires_axis():
+    mesh = make_mesh((8,), ("data",), devices=jax.devices()[:8])
+    params = _make_params(8, 4)
+    with pytest.raises(ValueError, match="pipe"):
+        pipeline_apply(_stage_fn, params,
+                       jnp.zeros((2, 2, 4)), axis="pipe", mesh=mesh)
+
+
+def test_pipeline_rejects_mismatched_stage_count():
+    mesh = make_mesh((4,), ("pipe",), devices=jax.devices()[:4])
+    params = _make_params(8, 4)     # 8 stage slices on a 4-stage pipe
+    with pytest.raises(ValueError, match="leading dim 8"):
+        pipeline_apply(_stage_fn, params, jnp.zeros((2, 2, 4)),
+                       axis="pipe", mesh=mesh)
