@@ -301,26 +301,51 @@ def _lstm(ctx):
         w_fc = bflat[5 * h_dim:6 * h_dim].reshape(1, -1)
         w_oc = bflat[6 * h_dim:7 * h_dim].reshape(1, -1)
 
-    def step(carry, x_t):
-        h_prev, c_prev = carry
-        gates = x_t + h_prev @ w
-        if b is not None:
-            gates = gates + b.reshape(1, -1)[:, :4 * h_dim]
-        i, c_hat, f, o = jnp.split(gates, 4, axis=-1)
-        if use_peepholes:
-            i = i + w_ic * c_prev
-            f = f + w_fc * c_prev
-        i = gate_act(i)
-        f = gate_act(f)
-        c = f * c_prev + i * cand_act(c_hat)
-        if use_peepholes:
-            o = o + w_oc * c
-        o = gate_act(o)
-        h = o * cell_act(c)
-        return (h, c), (h, c)
+    # Hot path: the Pallas fused kernel keeps (h, c) in VMEM across all
+    # timesteps (the reference's hl_cuda_lstm.cu analog) — ~13% faster
+    # fwd+bwd than the unrolled scan on chip. Standard gates only;
+    # PADDLE_TPU_PALLAS_LSTM=0 disables.
+    lstm_knob = os.environ.get("PADDLE_TPU_PALLAS_LSTM", "1")
+    eligible = (
+        not use_peepholes
+        and ctx.attr("gate_activation", "sigmoid") == "sigmoid"
+        and ctx.attr("cell_activation", "tanh") == "tanh"
+        and ctx.attr("candidate_activation", "tanh") == "tanh")
+    # "force" runs the kernel in interpret mode off-TPU — lets tests
+    # cover this dispatch branch without hardware
+    use_fused = eligible and (
+        lstm_knob == "force"
+        or (lstm_knob == "1" and jax.default_backend() == "tpu"))
+    if use_fused:
+        from .pallas.fused_lstm import fused_lstm
+        bias = b.reshape(-1)[:4 * h_dim] if b is not None else \
+            jnp.zeros((4 * h_dim,), data.dtype)
+        h_tm, c_tm, h_last, c_last = fused_lstm(
+            jnp.moveaxis(data, 1, 0), w, bias, h0, c0, x.lengths,
+            None if lstm_knob == "force" else False)
+        hidden = jnp.moveaxis(h_tm, 0, 1)
+        cells = jnp.moveaxis(c_tm, 0, 1)
+    else:
+        def step(carry, x_t):
+            h_prev, c_prev = carry
+            gates = x_t + h_prev @ w
+            if b is not None:
+                gates = gates + b.reshape(1, -1)[:, :4 * h_dim]
+            i, c_hat, f, o = jnp.split(gates, 4, axis=-1)
+            if use_peepholes:
+                i = i + w_ic * c_prev
+                f = f + w_fc * c_prev
+            i = gate_act(i)
+            f = gate_act(f)
+            c = f * c_prev + i * cand_act(c_hat)
+            if use_peepholes:
+                o = o + w_oc * c
+            o = gate_act(o)
+            h = o * cell_act(c)
+            return (h, c), (h, c)
 
-    (h_last, c_last), (hidden, cells) = _masked_scan_rnn(
-        step, data, (h0, c0), x.lengths)
+        (h_last, c_last), (hidden, cells) = _masked_scan_rnn(
+            step, data, (h0, c0), x.lengths)
     if is_reverse:
         t = hidden.shape[1]
         idx = (x.lengths[:, None] - 1 - jnp.arange(t)[None, :]) % t
