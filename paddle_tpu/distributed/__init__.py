@@ -10,3 +10,5 @@ for etcd.
 from .master import Master, MasterServer, MasterClient  # noqa: F401
 from .checkpoint import (  # noqa: F401
     save_checkpoint, load_checkpoint, latest_checkpoint)
+from .multihost import (  # noqa: F401
+    cluster_env, init_multihost, make_multihost_mesh)

@@ -1,0 +1,115 @@
+"""Multi-host SPMD initialization (DCN scale-out).
+
+The reference reaches multi-node scale through its pserver transports
+(gRPC send/listen_and_serv, the legacy socket/RDMA pserver, the Go
+pserver) coordinated by env vars — the cluster contract in the book
+tests is TRAINING_ROLE / PADDLE_INIT_PSERVERS / PADDLE_INIT_TRAINER_ID /
+PADDLE_INIT_PORT (reference: tests/book/test_fit_a_line.py:71-81).
+
+The TPU-native equivalent has NO parameter servers: every host is an
+SPMD worker in one jax.distributed job, jax.devices() becomes the global
+device set, and a Mesh laid out with ICI axes innermost / DCN axes
+outermost makes GSPMD route collectives over the right fabric. The
+reference env spelling is therefore REPURPOSED: PADDLE_INIT_PSERVERS
+names the worker hosts themselves (its first entry is process 0 — the
+coordinator), not a separate pserver tier.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+
+from ..parallel.mesh import make_mesh
+
+
+def cluster_env(environ=None) -> Optional[Tuple[str, int, int]]:
+    """Resolve (coordinator_address, num_processes, process_id) from the
+    environment. Returns None when no multi-host contract is present
+    (single-host run). Recognized spellings, in precedence order:
+
+    1. COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID (jax-native)
+    2. PADDLE_INIT_PSERVERS (comma-separated worker-host list; the
+       FIRST entry is process 0 / the coordinator) +
+       PADDLE_INIT_TRAINER_ID + PADDLE_INIT_PORT +
+       optional PADDLE_INIT_NUM_TRAINERS (defaults to the host count)
+    """
+    env = environ if environ is not None else os.environ
+    if env.get("COORDINATOR_ADDRESS"):
+        missing = [k for k in ("NUM_PROCESSES", "PROCESS_ID")
+                   if not env.get(k)]
+        if missing:
+            raise ValueError(
+                "COORDINATOR_ADDRESS is set but "
+                f"{'/'.join(missing)} is missing")
+        spec = (env["COORDINATOR_ADDRESS"],
+                int(env["NUM_PROCESSES"]), int(env["PROCESS_ID"]))
+    else:
+        hosts = env.get("PADDLE_INIT_PSERVERS", "")
+        if not hosts:
+            return None
+        port = env.get("PADDLE_INIT_PORT", "6174")
+        first = hosts.split(",")[0].strip()
+        coord = first if ":" in first else f"{first}:{port}"
+        n = int(env.get("PADDLE_INIT_NUM_TRAINERS",
+                        str(len(hosts.split(",")))))
+        pid = int(env.get("PADDLE_INIT_TRAINER_ID", "0"))
+        spec = (coord, n, pid)
+    coord, n, pid = spec
+    if not (0 <= pid < n):
+        raise ValueError(
+            f"process id {pid} out of range for {n} processes — check "
+            "PROCESS_ID/PADDLE_INIT_TRAINER_ID and "
+            "NUM_PROCESSES/PADDLE_INIT_NUM_TRAINERS")
+    return spec
+
+
+def init_multihost(environ=None) -> bool:
+    """Join the multi-host job described by the environment (no-op on a
+    single host). Call once per process before touching devices.
+    Returns True when a multi-host job was joined."""
+    spec = cluster_env(environ)
+    if spec is None:
+        return False
+    coord, n, pid = spec
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=n, process_id=pid)
+    return True
+
+
+def make_multihost_mesh(ici_axes: Sequence[Tuple[str, int]],
+                        dcn_axis: str = "dcn"):
+    """Mesh with a leading cross-host axis over DCN and the given
+    intra-host (ICI) axes within each host.
+
+    ici_axes: [(name, size), ...] whose product must equal the local
+    device count of each host. Axis names come out as
+    (dcn_axis, *ici_names) — sharding over the leading axis makes GSPMD
+    place those collectives on DCN, everything else rides ICI (the
+    scaling-book layout rule). Uses mesh_utils'
+    create_hybrid_device_mesh on real multi-host topologies (ICI-torus
+    aware); falls back to a host-major reshape on emulated devices.
+    """
+    n_local = jax.local_device_count()
+    n_total = jax.device_count()
+    n_hosts = n_total // n_local
+    prod = int(np.prod([s for _, s in ici_axes]))
+    if prod != n_local:
+        raise ValueError(
+            f"ici axes {ici_axes} multiply to {prod} but each host has "
+            f"{n_local} devices")
+    names = (dcn_axis,) + tuple(n for n, _ in ici_axes)
+    ici_sizes = tuple(s for _, s in ici_axes)
+    if n_hosts > 1:
+        from jax.experimental import mesh_utils
+        from jax.sharding import Mesh
+        devices = mesh_utils.create_hybrid_device_mesh(
+            ici_sizes, (n_hosts,) + (1,) * (len(ici_sizes) - 1))
+        # hybrid mesh returns [dcn*ici...]-shaped array with DCN leading
+        mesh = Mesh(devices.reshape((n_hosts,) + ici_sizes), names)
+        from ..parallel.mesh import set_mesh
+        set_mesh(mesh)
+        return mesh
+    return make_mesh((n_hosts,) + ici_sizes, names)

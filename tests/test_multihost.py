@@ -1,0 +1,54 @@
+"""Multi-host env contract + DCN/ICI mesh layout
+(paddle_tpu/distributed/multihost.py). Actual multi-process join cannot
+run in CI; the env resolution and mesh layout rules are what we pin."""
+import numpy as np
+import jax
+import pytest
+
+from paddle_tpu.distributed.multihost import (cluster_env,
+                                              make_multihost_mesh)
+
+
+def test_cluster_env_jax_native_spelling():
+    env = {"COORDINATOR_ADDRESS": "10.0.0.2:1234",
+           "NUM_PROCESSES": "4", "PROCESS_ID": "2"}
+    assert cluster_env(env) == ("10.0.0.2:1234", 4, 2)
+
+
+def test_cluster_env_reference_contract():
+    # reference cluster contract (test_fit_a_line.py:71-81):
+    # first pserver host is the coordinator
+    env = {"PADDLE_INIT_PSERVERS": "10.0.0.5,10.0.0.6",
+           "PADDLE_INIT_PORT": "6174",
+           "PADDLE_INIT_TRAINER_ID": "1"}
+    assert cluster_env(env) == ("10.0.0.5:6174", 2, 1)
+    env["PADDLE_INIT_NUM_TRAINERS"] = "8"
+    assert cluster_env(env) == ("10.0.0.5:6174", 8, 1)
+
+
+def test_cluster_env_absent_means_single_host():
+    assert cluster_env({}) is None
+
+
+def test_multihost_mesh_layout_single_host():
+    # on one host: dcn axis has size 1, ici axes split the local devices
+    mesh = make_multihost_mesh([("data", 4), ("model", 2)])
+    assert mesh.devices.shape == (1, 4, 2)
+    assert mesh.axis_names == ("dcn", "data", "model")
+
+
+def test_multihost_mesh_rejects_bad_ici_product():
+    with pytest.raises(ValueError, match="multiply to"):
+        make_multihost_mesh([("data", 3)])
+
+
+def test_cluster_env_rejects_out_of_range_pid():
+    env = {"PADDLE_INIT_PSERVERS": "10.0.0.5,10.0.0.6",
+           "PADDLE_INIT_TRAINER_ID": "3"}   # only 2 hosts, no n override
+    with pytest.raises(ValueError, match="out of range"):
+        cluster_env(env)
+
+
+def test_cluster_env_partial_jax_spelling_raises():
+    with pytest.raises(ValueError, match="NUM_PROCESSES"):
+        cluster_env({"COORDINATOR_ADDRESS": "10.0.0.2:1234"})
