@@ -91,6 +91,30 @@ def bench_resnet(pt):
     return BATCH * sps
 
 
+def bench_transformer(pt):
+    """Opt-in (BENCH_TRANSFORMER=1): transformer-base NMT train step.
+    Measured on chip at ~80k tokens/s (bs32, len 256, 6 layers, d512,
+    32k vocab, bf16, flash attention)."""
+    from paddle_tpu.models import transformer
+    b, ln = 32, 256
+    main_p, startup, f = transformer.build_train(
+        src_vocab=32000, trg_vocab=32000, max_len=ln, n_layer=6,
+        n_head=8, d_model=512, d_inner=2048, lr=1e-3)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {
+        "src_ids": rng.randint(1, 32000, (b, ln, 1)).astype(np.int64),
+        "trg_ids": rng.randint(1, 32000, (b, ln, 1)).astype(np.int64),
+        "trg_labels": rng.randint(1, 32000, (b, ln, 1)).astype(np.int64),
+        "pos_ids": np.arange(ln).astype(np.int64),
+    }
+    for v in feed.values():
+        v.flags.writeable = False
+    sps = _marginal_steps_per_sec(exe, main_p, feed, f["loss"])
+    return b * ln * sps
+
+
 def bench_lstm_lm(pt):
     from paddle_tpu.models import lstm_lm
     from paddle_tpu.core.lod import RaggedPair
@@ -137,6 +161,15 @@ def main():
                 tok_s / BASELINE_LSTM_TOKENS_PER_SEC, 2)
         except Exception as e:  # extras must never sink the headline
             extras["lstm_lm_error"] = repr(e)[:200]
+    if os.environ.get("BENCH_TRANSFORMER", "0") == "1":
+        try:
+            pt.reset_default_programs()
+            pt.reset_global_scope()
+            pt.amp.enable(amp_on)   # honor the PADDLE_TPU_AMP override
+            extras["transformer_tokens_per_sec"] = round(
+                bench_transformer(pt), 0)
+        except Exception as e:
+            extras["transformer_error"] = repr(e)[:200]
 
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec",
