@@ -7,6 +7,27 @@ Pallas kernels; everything else rides XLA fusion.
 
 All kernels run in interpret mode on CPU (tests) and compiled on TPU.
 """
-from .flash_attention import flash_attention
+import os
 
-__all__ = ["flash_attention"]
+import jax
+
+
+def interpret_default() -> bool:
+    """Interpret kernels off-TPU (tests); compile on real hardware."""
+    return jax.default_backend() != "tpu"
+
+
+def pallas_dispatch(knob_env: str, default: str):
+    """Shared env-knob policy for op-level kernel dispatch:
+    returns (enabled, interpret). "1" enables on TPU only, "force"
+    enables anywhere via interpret mode (test coverage), "0" disables.
+    """
+    knob = os.environ.get(knob_env, default)
+    if knob == "force":
+        return True, None          # None -> interpret_default() inside
+    return (knob == "1" and jax.default_backend() == "tpu"), False
+
+
+from .flash_attention import flash_attention  # noqa: E402
+
+__all__ = ["flash_attention", "interpret_default", "pallas_dispatch"]

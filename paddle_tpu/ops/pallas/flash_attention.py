@@ -35,6 +35,8 @@ def _ceil_to(x: int, m: int) -> int:
 
 
 def _interpret_default() -> bool:
+    # keep in sync with paddle_tpu.ops.pallas.interpret_default (this
+    # module is imported BY the package __init__, so it cannot import it)
     return jax.default_backend() != "tpu"
 
 

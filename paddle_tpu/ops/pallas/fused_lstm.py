@@ -29,8 +29,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _interpret_default() -> bool:
-    return jax.default_backend() != "tpu"
+from . import interpret_default as _interpret_default  # shared policy
 
 
 def _fwd_kernel(len_ref, x_ref, w_ref, b_ref, h0_ref, c0_ref,

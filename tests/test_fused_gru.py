@@ -1,0 +1,103 @@
+"""Pallas fused GRU vs the scan-based oracle (interpret mode on CPU;
+compiles on real TPU — companion to test_fused_lstm.py)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.fused_gru import fused_gru
+
+
+def _scan_gru(x, w, h0, lengths):
+    hidden = w.shape[0]
+    w_ur, w_c = w[:, :2 * hidden], w[:, 2 * hidden:]
+    t_max = x.shape[0]
+
+    def step(carry, inp):
+        t, x_t = inp
+        h_prev = carry
+        xu, xr, xc = jnp.split(x_t, 3, axis=-1)
+        ur = h_prev @ w_ur
+        u = jax.nn.sigmoid(xu + ur[:, :hidden])
+        r = jax.nn.sigmoid(xr + ur[:, hidden:])
+        c = jnp.tanh(xc + (r * h_prev) @ w_c)
+        h = u * h_prev + (1 - u) * c
+        alive = (t < lengths)[:, None]
+        return jnp.where(alive, h, h_prev), jnp.where(alive, h, 0.0)
+
+    ts = jnp.arange(t_max, dtype=jnp.int32)
+    h_l, h_all = jax.lax.scan(step, h0, (ts, x))
+    return h_all, h_l
+
+
+def _data(t_max=6, bsz=4, hidden=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(t_max, bsz, 3 * hidden).astype(np.float32) * 0.5
+    w = rng.randn(hidden, 3 * hidden).astype(np.float32) * 0.3
+    h0 = rng.randn(bsz, hidden).astype(np.float32) * 0.2
+    lens = rng.randint(0, t_max + 1, bsz).astype(np.int32)
+    lens[0] = 0                     # include an empty row
+    lens[1] = t_max
+    return tuple(map(jnp.asarray, (x, w, h0, lens)))
+
+
+def test_forward_matches_scan_ragged():
+    x, w, h0, lens = _data(seed=1)
+    got = fused_gru(x, w, h0, lens, True)
+    ref = _scan_gru(x, w, h0, lens)
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(ref[0]),
+                               atol=1e-5)
+    # zero-length rows keep the initial state
+    np.testing.assert_allclose(np.asarray(got[1]), np.asarray(ref[1]),
+                               atol=1e-5)
+
+
+def test_gradients_match_scan():
+    x, w, h0, lens = _data(seed=2)
+    rng = np.random.RandomState(3)
+    wh = jnp.asarray(rng.randn(*(x.shape[:2] + (w.shape[0],))
+                               ).astype(np.float32))
+    wl = jnp.asarray(rng.randn(x.shape[1], w.shape[0]).astype(np.float32))
+
+    def loss_fused(x, w, h0):
+        h_all, h_l = fused_gru(x, w, h0, lens, True)
+        return jnp.sum(h_all * wh) + jnp.sum(h_l * wl)
+
+    def loss_scan(x, w, h0):
+        h_all, h_l = _scan_gru(x, w, h0, lens)
+        return jnp.sum(h_all * wh) + jnp.sum(h_l * wl)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2))(x, w, h0)
+    gs = jax.grad(loss_scan, argnums=(0, 1, 2))(x, w, h0)
+    for name, a, r in zip(("dx", "dw", "dh0"), gf, gs):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   atol=2e-4, rtol=2e-4, err_msg=name)
+
+
+def test_gru_op_dispatch_fused_matches_scan(monkeypatch):
+    from op_test import OpTestHarness
+    from paddle_tpu.core.lod import RaggedPair
+    import paddle_tpu as pt
+
+    rng = np.random.RandomState(5)
+    B, T, H = 3, 5, 4
+    data = rng.randn(B, T, 3 * H).astype(np.float32) * 0.3
+    lens = np.asarray([5, 2, 4], np.int32)
+    w = rng.randn(H, 3 * H).astype(np.float32) * 0.3
+    bias = rng.randn(1, 3 * H).astype(np.float32) * 0.1
+
+    def run():
+        pt.reset_default_programs(); pt.reset_global_scope()
+        t = OpTestHarness("gru",
+                          {"Input": ("x", RaggedPair(data, lens)),
+                           "Weight": ("w", w), "Bias": ("bb", bias)},
+                          out_slots=["Hidden", "LastH"])
+        outs = t.run_forward()
+        return {k: np.asarray(v.data if hasattr(v, "data") else v)
+                for k, v in outs.items()}
+
+    monkeypatch.delenv("PADDLE_TPU_PALLAS_GRU", raising=False)
+    ref = run()
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_GRU", "force")
+    got = run()
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], atol=1e-4, err_msg=k)
