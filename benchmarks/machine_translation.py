@@ -1,0 +1,38 @@
+"""Transformer NMT benchmark (reference: benchmark/fluid/
+machine_translation.py benchmarks its seq2seq; the transformer is this
+framework's flagship NMT model)."""
+import numpy as np
+
+
+def main():
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from common import parse_args, run_benchmark
+    args = parse_args({"--seq_len": {"type": int, "default": 256},
+                       "--n_layer": {"type": int, "default": 6},
+                       "--d_model": {"type": int, "default": 512}})
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+    pt.amp.enable(not args.no_amp)
+    main_p, startup, f = transformer.build_train(
+        src_vocab=32000, trg_vocab=32000, max_len=args.seq_len,
+        n_layer=args.n_layer, n_head=8, d_model=args.d_model,
+        d_inner=4 * args.d_model, lr=1e-3)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    b, ln = args.batch_size, args.seq_len
+    feed = {
+        "src_ids": rng.randint(1, 32000, (b, ln, 1)).astype(np.int64),
+        "trg_ids": rng.randint(1, 32000, (b, ln, 1)).astype(np.int64),
+        "trg_labels": rng.randint(1, 32000, (b, ln, 1)).astype(np.int64),
+        "pos_ids": np.arange(ln).astype(np.int64),
+    }
+    for v in feed.values():
+        v.flags.writeable = False
+    run_benchmark(exe, main_p, feed, f["loss"], args, b * ln, "tokens")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Stacked dynamic-LSTM LM benchmark (reference:
+benchmark/fluid/stacked_dynamic_lstm.py)."""
+import numpy as np
+
+
+def main():
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from common import parse_args, run_benchmark
+    args = parse_args({"--seq_len": {"type": int, "default": 64},
+                       "--hid_dim": {"type": int, "default": 512},
+                       "--stacked_num": {"type": int, "default": 2}})
+    import paddle_tpu as pt
+    from paddle_tpu.models import lstm_lm
+    from paddle_tpu.core.lod import RaggedPair
+    # scan/fused LSTM is latency-bound; bf16 casts only add overhead
+    pt.amp.enable(False)
+    main_p, startup, f = lstm_lm.build_train(
+        vocab_size=10000, emb_dim=256, hid_dim=args.hid_dim,
+        num_layers=args.stacked_num, lr=1.0)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 10000, (args.batch_size, args.seq_len, 1)
+                      ).astype(np.int64)
+    ids.flags.writeable = False
+    lens = np.full((args.batch_size,), args.seq_len, np.int32)
+    lens.flags.writeable = False
+    feed = {"words": RaggedPair(ids, lens),
+            "targets": RaggedPair(ids, lens)}
+    run_benchmark(exe, main_p, feed, f["loss"], args,
+                  args.batch_size * args.seq_len, "tokens")
+
+
+if __name__ == "__main__":
+    main()

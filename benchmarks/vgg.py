@@ -1,0 +1,29 @@
+"""VGG-16 benchmark (reference: benchmark/fluid/vgg.py)."""
+import numpy as np
+
+
+def main():
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from common import parse_args, run_benchmark
+    args = parse_args({"--class_dim": {"type": int, "default": 1000}})
+    import paddle_tpu as pt
+    from paddle_tpu.models import vgg
+    pt.amp.enable(not args.no_amp)
+    main_p, startup, f = vgg.build_train(
+        class_dim=args.class_dim, image_shape=(3, 224, 224), lr=0.01)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    img = rng.rand(args.batch_size, 3, 224, 224).astype(np.float32)
+    lbl = rng.randint(0, args.class_dim,
+                      (args.batch_size, 1)).astype(np.int64)
+    img.flags.writeable = False
+    lbl.flags.writeable = False
+    run_benchmark(exe, main_p, {"img": img, "label": lbl}, f["loss"],
+                  args, args.batch_size, "images")
+
+
+if __name__ == "__main__":
+    main()

@@ -34,10 +34,7 @@ def _ceil_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def _interpret_default() -> bool:
-    # keep in sync with paddle_tpu.ops.pallas.interpret_default (this
-    # module is imported BY the package __init__, so it cannot import it)
-    return jax.default_backend() != "tpu"
+from . import interpret_default as _interpret_default  # shared policy
 
 
 def _clamp_blocks(sq, sk, block_q, block_k, interpret):

@@ -370,6 +370,14 @@ def _gru(ctx):
     h0 = ctx.input("H0")
     h0 = h0 if h0 is not None else jnp.zeros((n, h_dim), x.data.dtype)
 
+    data = x.data
+    is_reverse = ctx.attr("is_reverse", False)
+    if is_reverse:
+        # reverse each sequence's valid prefix (as the lstm op does)
+        t = data.shape[1]
+        ridx = (x.lengths[:, None] - 1 - jnp.arange(t)[None, :]) % t
+        data = jnp.take_along_axis(data, ridx[..., None], axis=1)
+
     # Opt-in (default off): correctness is verified on chip, but a
     # trustworthy perf A/B was not obtainable through the TPU tunnel's
     # noisy dispatch — enable once measured on direct hardware.
@@ -379,9 +387,9 @@ def _gru(ctx):
                 and ctx.attr("activation", "tanh") == "tanh")
     if enabled and eligible:
         from .pallas.fused_gru import fused_gru
-        data = x.data if b is None else x.data + b.reshape(1, 1, -1)
+        gdata = data if b is None else data + b.reshape(1, 1, -1)
         h_tm, h_last = fused_gru(
-            jnp.moveaxis(data, 1, 0), w, h0, x.lengths, interp)
+            jnp.moveaxis(gdata, 1, 0), w, h0, x.lengths, interp)
         hidden = jnp.moveaxis(h_tm, 0, 1)
     else:
         def step(carry, x_t):
@@ -397,8 +405,12 @@ def _gru(ctx):
             h = u * h_prev + (1 - u) * c
             return (h,), h
 
-        (h_last,), hidden = _masked_scan_rnn(step, x.data, (h0,),
+        (h_last,), hidden = _masked_scan_rnn(step, data, (h0,),
                                              x.lengths)
+    if is_reverse:
+        t = hidden.shape[1]
+        ridx = (x.lengths[:, None] - 1 - jnp.arange(t)[None, :]) % t
+        hidden = jnp.take_along_axis(hidden, ridx[..., None], axis=1)
     ctx.set_output("Hidden", RaggedPair(hidden, x.lengths))
     ctx.set_output("LastH", h_last)
 

@@ -101,3 +101,42 @@ def test_gru_op_dispatch_fused_matches_scan(monkeypatch):
     got = run()
     for k in ref:
         np.testing.assert_allclose(got[k], ref[k], atol=1e-4, err_msg=k)
+
+
+def test_gru_is_reverse_matches_manual_flip(monkeypatch):
+    """is_reverse must process each row's valid prefix back-to-front
+    (regression: the attr used to be silently ignored)."""
+    from op_test import OpTestHarness
+    from paddle_tpu.core.lod import RaggedPair
+    import paddle_tpu as pt
+
+    rng = np.random.RandomState(7)
+    B, T, H = 2, 4, 3
+    data = rng.randn(B, T, 3 * H).astype(np.float32) * 0.3
+    lens = np.asarray([4, 2], np.int32)
+    w = rng.randn(H, 3 * H).astype(np.float32) * 0.3
+
+    def run(d, ln, reverse):
+        pt.reset_default_programs(); pt.reset_global_scope()
+        t = OpTestHarness("gru",
+                          {"Input": ("x", RaggedPair(d, ln)),
+                           "Weight": ("w", w)},
+                          attrs={"is_reverse": reverse},
+                          out_slots=["Hidden", "LastH"])
+        o = t.run_forward()
+        return {k: np.asarray(v.data if hasattr(v, "data") else v)
+                for k, v in o.items()}
+
+    rev = run(data, lens, True)
+    # manual flip of each valid prefix, forward pass, flip back
+    flipped = data.copy()
+    for i, n in enumerate(lens):
+        flipped[i, :n] = data[i, :n][::-1]
+    fwd = run(flipped, lens, False)
+    # Hidden comes back packed [sum(lens), 3]: flip each row's segment
+    segs, pos = [], 0
+    for n in lens:
+        segs.append(fwd["Hidden"][pos:pos + n][::-1])
+        pos += n
+    np.testing.assert_allclose(rev["Hidden"], np.concatenate(segs),
+                               atol=1e-5)
