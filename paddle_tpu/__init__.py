@@ -25,6 +25,7 @@ from . import nets  # noqa
 from . import io  # noqa
 from . import metrics  # noqa
 from . import profiler  # noqa
+from . import flags  # noqa
 from .parallel import ParallelExecutor  # noqa
 from . import reader  # noqa
 from .reader import batch  # noqa

@@ -1,0 +1,75 @@
+"""Central registry of the framework's environment flags.
+
+The reference wires gflags end-to-end and re-exports selected C++ flags
+into Python via `core.init_gflags(["--tryfromenv=..."])` at import
+(reference: python/paddle/fluid/__init__.py:76-111 — use_pinned_memory,
+check_nan_inf, benchmark, fraction_of_gpu_memory_to_use, ...). The
+TPU-native analog is plain environment variables read at trace/run time;
+this module is the single place they are all documented and inspectable
+(`paddle_tpu.flags.dump()`), replacing the reference's --help surface.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+# name -> (default, where it is read, what it does)
+FLAGS: Dict[str, tuple] = {
+    "PADDLE_TPU_AMP": (
+        "0", "amp.py / bench.py",
+        "bf16 mixed precision (f32 master weights); bench enables it"),
+    "PADDLE_TPU_CHECK_NAN_INF": (
+        "0", "core/executor.py",
+        "scan fetched values for NaN/Inf after each run (reference "
+        "FLAGS_check_nan_inf)"),
+    "PADDLE_TPU_CONV_LAYOUT": (
+        "nchw", "ops/nn_ops.py",
+        "conv internal layout A/B knob ('nhwc' transposes at conv "
+        "boundaries; XLA cancels them between convs). NCHW measured "
+        ">= NHWC on chip"),
+    "PADDLE_TPU_RNN_UNROLL": (
+        "4", "ops/sequence_ops.py",
+        "lax.scan unroll factor for masked RNN scans; 1 disables "
+        "(also accepts off/false/no/none/disabled)"),
+    "PADDLE_TPU_PALLAS_LSTM": (
+        "1", "ops/sequence_ops.py",
+        "fused Pallas LSTM kernel on TPU ('force' = interpret mode "
+        "anywhere for tests, '0' = scan path)"),
+    "PADDLE_TPU_PALLAS_GRU": (
+        "0", "ops/sequence_ops.py",
+        "fused Pallas GRU kernel (opt-in pending direct-hardware perf "
+        "measurement; same force/0/1 semantics)"),
+    "PADDLE_TPU_DATA_HOME": (
+        "~/.cache/paddle_tpu/dataset", "dataset/common.py",
+        "dataset download/cache directory"),
+    "PADDLE_TPU_FEED_CACHE_MAX": (
+        "8", "core/executor.py",
+        "max entries in the device-side feed cache (frozen ndarrays "
+        "uploaded once)"),
+    # bench-only knobs
+    "BENCH_BATCH": ("128", "bench.py", "ResNet bench batch size"),
+    "BENCH_WARMUP": ("3", "bench.py", "warmup steps"),
+    "BENCH_N1": ("5", "bench.py", "short marginal-timing run"),
+    "BENCH_N2": ("25", "bench.py", "long marginal-timing run"),
+    "BENCH_EXTRAS": ("1", "bench.py", "run the LSTM-LM extra metric"),
+    "BENCH_TRANSFORMER": ("0", "bench.py",
+                          "run the transformer extra metric"),
+}
+
+
+def get(name: str) -> str:
+    """Current value of a registered flag (env or default)."""
+    if name not in FLAGS:
+        raise KeyError(f"unknown flag {name!r}; see paddle_tpu.flags.FLAGS")
+    return os.environ.get(name, FLAGS[name][0])
+
+
+def dump() -> str:
+    """Human-readable table of every flag: current value, default,
+    reader, description."""
+    lines = []
+    for name, (default, where, desc) in sorted(FLAGS.items()):
+        cur = os.environ.get(name)
+        mark = f"{cur} (set)" if cur is not None else f"{default}"
+        lines.append(f"{name} = {mark}\n    [{where}] {desc}")
+    return "\n".join(lines)
