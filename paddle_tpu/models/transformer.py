@@ -91,8 +91,10 @@ def _position_encoding_table(max_len, d_model):
     return table
 
 
-def embed(ids, vocab_size, d_model, max_len, pos_ids):
-    word = layers.embedding(ids, size=[vocab_size, d_model])
+def embed(ids, vocab_size, d_model, max_len, pos_ids,
+          dist_embedding=False):
+    word = layers.embedding(ids, size=[vocab_size, d_model],
+                            is_distributed=dist_embedding)
     pe = layers.assign(_position_encoding_table(max_len, d_model))
     pos = layers.gather(pe, pos_ids)  # [t, d_model]
     return layers.elementwise_add(word, pos, axis=-1)
@@ -111,13 +113,15 @@ def transformer(src_ids, trg_ids, trg_labels, pos_src, pos_trg,
                 src_vocab=10000, trg_vocab=10000, max_len=64, n_layer=2,
                 n_head=8, d_model=512, d_inner=2048, dropout=0.0,
                 causal_mask=None, pad_id=0, seq_axis=None,
-                seq_impl="ring"):
+                seq_impl="ring", dist_embedding=False):
     src_mask = _pad_attn_mask(src_ids, pad_id)
-    enc = embed(src_ids, src_vocab, d_model, max_len, pos_src)
+    enc = embed(src_ids, src_vocab, d_model, max_len, pos_src,
+                dist_embedding=dist_embedding)
     for _ in range(n_layer):
         enc = encoder_layer(enc, d_model, n_head, d_inner, src_mask,
                             dropout, seq_axis=seq_axis, seq_impl=seq_impl)
-    dec = embed(trg_ids, trg_vocab, d_model, max_len, pos_trg)
+    dec = embed(trg_ids, trg_vocab, d_model, max_len, pos_trg,
+                dist_embedding=dist_embedding)
     if seq_axis:
         if causal_mask is not None:
             raise ValueError(
@@ -155,7 +159,7 @@ def transformer(src_ids, trg_ids, trg_labels, pos_src, pos_trg,
 
 def build_train(src_vocab=10000, trg_vocab=10000, max_len=64, n_layer=2,
                 n_head=8, d_model=512, d_inner=2048, lr=1e-3,
-                seq_axis=None, seq_impl="ring"):
+                seq_axis=None, seq_impl="ring", dist_embedding=False):
     import paddle_tpu as pt
     main, startup = pt.Program(), pt.Program()
     with pt.program_guard(main, startup):
@@ -173,6 +177,7 @@ def build_train(src_vocab=10000, trg_vocab=10000, max_len=64, n_layer=2,
                                    trg_vocab, max_len, n_layer, n_head,
                                    d_model, d_inner,
                                    causal_mask=causal, seq_axis=seq_axis,
-                                   seq_impl=seq_impl)
+                                   seq_impl=seq_impl,
+                                   dist_embedding=dist_embedding)
         opt.AdamOptimizer(learning_rate=lr).minimize(loss)
     return main, startup, {"loss": loss}
