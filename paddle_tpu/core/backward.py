@@ -136,15 +136,18 @@ def _generic_grad_op(op: OpDesc, block: BlockDesc, acc: _GradAccumulator,
     if not any(in_need_grad):
         return None
 
-    if op.type == "while":
+    if op.type == "while" and \
+            not (isinstance(op.attrs.get("max_steps"), int)
+                 and op.attrs.get("max_steps", 0) > 0):
         # lax.while_loop has no reverse-mode rule; the reference's
-        # WhileGrad (while_op.cc:96) replays step scopes — the scan-based
-        # equivalents are the trainable path here.
+        # WhileGrad (while_op.cc:96) replays step scopes. The trainable
+        # paths here: While(cond, max_steps=N) (bounded-scan lowering,
+        # differentiable) or the scan-based DynamicRNN / StaticRNN.
         raise NotImplementedError(
-            "gradients through a While loop are not supported: use "
-            "DynamicRNN / StaticRNN (lax.scan-based, fully "
-            "differentiable) for trainable recurrences, or mark the "
-            "loop's inputs stop_gradient")
+            "gradients through an unbounded While loop are not "
+            "supported: pass max_steps=N to While (bounded, "
+            "differentiable scan lowering), use DynamicRNN / StaticRNN "
+            "for recurrences, or mark the loop's inputs stop_gradient")
 
     out_grad_names = [acc.materialize(n)
                       for n, h in zip(fwd_out_names, out_has_grad) if h]

@@ -343,11 +343,18 @@ class While:
     """While loop over a boolean condition var (reference:
     control_flow.py:608 / while_op.cc). Loop-carried state is every var
     the body writes that exists before the loop; lowered to
-    jax.lax.while_loop."""
+    jax.lax.while_loop — or, with `max_steps`, to a bounded masked scan
+    that is fully differentiable (the WhileGrad-capability path)."""
 
-    def __init__(self, cond: Variable, name=None):
+    def __init__(self, cond: Variable, name=None, max_steps=None):
+        if max_steps is not None and (not isinstance(max_steps, int)
+                                      or max_steps <= 0):
+            raise ValueError(
+                f"While max_steps must be a positive int, got "
+                f"{max_steps!r}")
         self.helper = LayerHelper("while", name=name)
         self.cond_var = cond
+        self.max_steps = max_steps
         self._block = None
 
     def block(self):
@@ -384,7 +391,8 @@ class While:
             outputs={"Out": written},
             attrs={"sub_block_idx": blk.idx,
                    "carried_names": written,
-                   "cond_name": self.cond_var.name})
+                   "cond_name": self.cond_var.name,
+                   "max_steps": int(self.max_steps or 0)})
 
 
 class Switch:

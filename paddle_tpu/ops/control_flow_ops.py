@@ -54,23 +54,53 @@ def _static_rnn(ctx):
 
 @register_op_CF("while")
 def _while(ctx):
+    """While loop. Two lowerings:
+
+    - default: lax.while_loop — dynamic trip count, minimal compute,
+      but NOT reverse-differentiable (XLA has no rule for it);
+    - with a positive `max_steps` attr: a bounded lax.scan that runs
+      max_steps iterations with an active mask (finished state passes
+      through) — same result for loops that terminate within the bound,
+      and fully differentiable, the TPU-native WhileGrad
+      (reference: while_op.cc:96 step-scope replay)."""
     cond_name = ctx.attr("cond_name")
     carried = ctx.attr("carried_names")
     blk_idx = ctx.attr("sub_block_idx")
+    max_steps = int(ctx.attr("max_steps", 0) or 0)
     outer = dict(ctx.env)
     cond0 = ctx.input("Cond")
     init = tuple(outer[n] for n in carried)
+
+    def body_env(vals):
+        env = dict(outer)
+        env.update(zip(carried, vals))
+        env = _trace_sub(ctx, blk_idx, env)
+        return (env[cond_name].reshape(()).astype(jnp.bool_),
+                tuple(env[n] for n in carried))
+
+    if max_steps > 0:
+        def scan_body(state, _):
+            active, vals = state
+            new_cond, new_vals = body_env(vals)
+            # carries may be pytrees (e.g. RaggedPair): select per leaf
+            kept = tuple(
+                jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(active, a, b), n, o)
+                for n, o in zip(new_vals, vals))
+            return (active & new_cond, kept), None
+
+        state0 = (cond0.reshape(()).astype(jnp.bool_), init)
+        (_, final_vals), _ = jax.lax.scan(scan_body, state0, None,
+                                          length=max_steps)
+        ctx.set_outputs("Out", list(final_vals))
+        return
 
     def cond_fn(state):
         return state[0].reshape(())
 
     def body_fn(state):
-        vals = state[1:]
-        env = dict(outer)
-        env.update(zip(carried, vals))
-        env = _trace_sub(ctx, blk_idx, env)
-        return (env[cond_name].reshape(()).astype(jnp.bool_),) + \
-            tuple(env[n] for n in carried)
+        new_cond, new_vals = body_env(state[1:])
+        return (new_cond,) + new_vals
 
     final = jax.lax.while_loop(
         cond_fn, body_fn, (cond0.reshape(()).astype(jnp.bool_),) + init)
