@@ -183,3 +183,37 @@ def test_bounded_while_matches_unbounded_values():
         return float(np.asarray(a)[0])
 
     assert build(None) == build(16) == 16.0   # 1 + 5*3
+
+
+def test_if_else_trains_through_both_branches():
+    """Gradients flow through IfElse: both branch params train (the
+    closure-grad mechanism covers sub-block parameters)."""
+    rng = np.random.RandomState(0)
+    xv = rng.randn(16, 4).astype(np.float32)
+    yv = np.where(xv.sum(1, keepdims=True) > 0,
+                  xv.sum(1, keepdims=True) * 2.0,
+                  xv.sum(1, keepdims=True) * -3.0).astype(np.float32)
+    pt.reset_default_programs(); pt.reset_global_scope()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        s = layers.reduce_sum(x, dim=[1], keep_dim=True)
+        zero = layers.fill_constant([1], "float32", 0.0)
+        from paddle_tpu.layers import ops as lops
+        cond = lops.greater_than(s, zero)
+        ie = cf.IfElse(cond)
+        with ie.true_block():
+            ie.output(layers.fc(ie.input(x), size=1))
+        with ie.false_block():
+            ie.output(layers.fc(ie.input(x), size=1))
+        out = ie()
+        loss = layers.mean(layers.square(out - y))
+        pt.optimizer.AdamOptimizer(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    losses = []
+    for _ in range(60):
+        (lv,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])

@@ -577,3 +577,32 @@ def test_flags_registry_matches_actual_env_reads():
     out = flags.dump()
     for name in flags.FLAGS:
         assert name in out
+
+
+def test_nce_trains_word_embeddings():
+    """NCE loss decreases when embeddings learn co-occurrence — the
+    word2vec training path (reference: nce_op.cc)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    pt.reset_default_programs(); pt.reset_global_scope()
+    V, D, B = 20, 8, 32
+    rng = np.random.RandomState(0)
+    ctx_ids = rng.randint(0, V, (B, 1)).astype(np.int64)
+    # deterministic target: next word = (ctx * 3 + 1) % V
+    tgt_ids = ((ctx_ids * 3 + 1) % V).astype(np.int64)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ctx_in = layers.data("ctx", [1], dtype="int64")
+        tgt = layers.data("tgt", [1], dtype="int64")
+        emb = layers.embedding(ctx_in, size=[V, D])
+        loss = layers.mean(layers.nce(emb, tgt, num_total_classes=V,
+                                      num_neg_samples=5))
+        pt.optimizer.AdamOptimizer(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    losses = []
+    for _ in range(40):
+        (lv,) = exe.run(main, feed={"ctx": ctx_ids, "tgt": tgt_ids},
+                        fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
