@@ -20,7 +20,7 @@ def main():
     rng = np.random.RandomState(0)
     img = rng.rand(args.batch_size, 3, 224, 224).astype(np.float32)
     lbl = rng.randint(0, args.class_dim,
-                      (args.batch_size, 1)).astype(np.int32)
+                      (args.batch_size, 1)).astype(np.int64)
     img.flags.writeable = False
     lbl.flags.writeable = False
     run_benchmark(exe, main_p, {"img": img, "label": lbl}, f["loss"],

@@ -113,3 +113,57 @@ def test_pipeline_rejects_mismatched_stage_count():
     with pytest.raises(ValueError, match="leading dim 8"):
         pipeline_apply(_stage_fn, params, jnp.zeros((2, 2, 4)),
                        axis="pipe", mesh=mesh)
+
+
+def test_pipeline_transformer_blocks():
+    """Pipeline over identical transformer blocks (the realistic
+    program shape: stacked per-stage params), gradients vs sequential."""
+    mesh = make_mesh((4,), ("pipe",), devices=jax.devices()[:4])
+    d, heads, n_micro, mb, seq = 8, 2, 4, 2, 6
+    rng = np.random.RandomState(0)
+
+    def make_block_params(n):
+        def g(*shape):
+            return jnp.asarray(rng.randn(n, *shape).astype(np.float32)
+                               * 0.2)
+        return {"wq": g(d, d), "wk": g(d, d), "wv": g(d, d),
+                "wo": g(d, d), "w1": g(d, 2 * d), "w2": g(2 * d, d)}
+
+    def block(p, x):                       # x: [mb, seq, d]
+        q = x @ p["wq"]
+        k = x @ p["wk"]
+        v = x @ p["wv"]
+        hd = d // heads
+        def split(t):
+            return t.reshape(mb, seq, heads, hd).transpose(0, 2, 1, 3)
+        s = jnp.einsum("bhqd,bhkd->bhqk", split(q), split(k)) / np.sqrt(hd)
+        a = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", a, split(v))
+        o = o.transpose(0, 2, 1, 3).reshape(mb, seq, d) @ p["wo"]
+        h = x + o
+        return h + jnp.tanh(h @ p["w1"]) @ p["w2"]
+
+    params = make_block_params(4)
+    x = jnp.asarray(rng.randn(n_micro * mb, seq, d).astype(np.float32))
+    y = jnp.asarray(rng.randn(n_micro * mb, seq, d).astype(np.float32))
+    micro = split_microbatches(x, n_micro)
+
+    def loss_pipe(params):
+        out = merge_microbatches(pipeline_apply(
+            block, params, micro, axis="pipe", mesh=mesh))
+        return jnp.mean((out - y) ** 2)
+
+    def loss_seq(params):
+        h = x.reshape(n_micro, mb, seq, d)
+        for i in range(4):
+            p_i = jax.tree_util.tree_map(lambda a: a[i], params)
+            h = jax.vmap(lambda hh: block(p_i, hh))(h)
+        return jnp.mean((h.reshape(-1, seq, d) - y) ** 2)
+
+    np.testing.assert_allclose(float(loss_pipe(params)),
+                               float(loss_seq(params)), rtol=1e-5)
+    gp = jax.grad(loss_pipe)(params)
+    gs = jax.grad(loss_seq)(params)
+    for k in gp:
+        np.testing.assert_allclose(np.asarray(gp[k]), np.asarray(gs[k]),
+                                   atol=1e-4, err_msg=k)
