@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from ..amp import amp_enabled
 from .ir import Program, BlockDesc, OpDesc
-from .lod import LoDTensor, RaggedPair
+from .lod import LoDTensor, RaggedNested, RaggedPair
 from .registry import run_op
 from .scope import Scope, global_scope
 
@@ -80,7 +80,19 @@ def _to_device_value(value):
         # the padded batch over the host link
         return RaggedPair(_maybe_cached(value.data),
                           _maybe_cached(value.lengths))
+    if isinstance(value, RaggedNested):
+        return RaggedNested(_maybe_cached(value.data),
+                            _maybe_cached(value.sub_lengths),
+                            _maybe_cached(value.tok_lengths))
     if isinstance(value, LoDTensor):
+        if len(value.lod) > 2:
+            raise ValueError(
+                f"feeds support at most 2 LoD levels (got "
+                f"{len(value.lod)}); flatten outer levels on the host")
+        if len(value.lod) == 2:
+            data, sub_l, tok_l = value.to_nested_padded()
+            return RaggedNested(jnp.asarray(data), jnp.asarray(sub_l),
+                                jnp.asarray(tok_l))
         if value.lod:
             padded, lengths = value.to_padded()
             return RaggedPair(jnp.asarray(padded), jnp.asarray(lengths))
@@ -93,6 +105,10 @@ def _to_host_value(value, return_numpy: bool):
         padded = np.asarray(value.data)
         lengths = np.asarray(value.lengths)
         return LoDTensor.from_padded(padded, lengths)
+    if isinstance(value, RaggedNested):
+        return LoDTensor.from_nested_padded(
+            np.asarray(value.data), np.asarray(value.sub_lengths),
+            np.asarray(value.tok_lengths))
     return np.asarray(value) if return_numpy else value
 
 
@@ -100,6 +116,9 @@ def _abstractify(value):
     if isinstance(value, RaggedPair):
         return ("ragged", value.data.shape, str(value.data.dtype),
                 value.lengths.shape)
+    if isinstance(value, RaggedNested):
+        return ("ragged2", value.data.shape, str(value.data.dtype),
+                value.tok_lengths.shape)
     return (tuple(value.shape), str(value.dtype))
 
 

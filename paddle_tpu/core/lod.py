@@ -54,6 +54,61 @@ class RaggedPair:
         return cls(*children)
 
 
+class RaggedNested:
+    """In-graph two-level ragged value (reference: 2-level LoD, e.g.
+    paragraph -> sentence -> token; lod_tensor.h:55-107 and the
+    RecurrentGradientMachine nested-sequence case).
+
+    data: [n_outer, max_sub, max_tok, *feature_dims] (zero padded)
+    sub_lengths: int32 [n_outer]          — sub-sequences per outer seq
+    tok_lengths: int32 [n_outer, max_sub] — tokens per sub-sequence
+    """
+
+    __slots__ = ("data", "sub_lengths", "tok_lengths")
+
+    def __init__(self, data, sub_lengths, tok_lengths):
+        self.data = data
+        self.sub_lengths = sub_lengths
+        self.tok_lengths = tok_lengths
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def sub_mask(self):
+        """[n_outer, max_sub] validity of sub-sequence slots."""
+        max_sub = self.data.shape[1]
+        pos = jnp.arange(max_sub, dtype=jnp.int32)[None, :]
+        return pos < self.sub_lengths[:, None]
+
+    def mask(self):
+        """[n_outer, max_sub, max_tok] token validity mask."""
+        max_tok = self.data.shape[2]
+        pos = jnp.arange(max_tok, dtype=jnp.int32)[None, None, :]
+        return (pos < self.tok_lengths[:, :, None]) \
+            & self.sub_mask()[:, :, None]
+
+    def flatten(self) -> "RaggedPair":
+        """View the sub-sequences as one level-1 ragged batch of
+        n_outer*max_sub rows (padding slots appear as length-0 rows)."""
+        n, s = self.data.shape[:2]
+        tok = jnp.where(self.sub_mask(), self.tok_lengths, 0)
+        return RaggedPair(
+            self.data.reshape((n * s,) + self.data.shape[2:]),
+            tok.reshape(n * s))
+
+    def tree_flatten(self):
+        return (self.data, self.sub_lengths, self.tok_lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
 def _register_pytree():
     try:
         import jax
@@ -61,6 +116,10 @@ def _register_pytree():
             RaggedPair,
             lambda rp: ((rp.data, rp.lengths), None),
             lambda aux, ch: RaggedPair(*ch))
+        jax.tree_util.register_pytree_node(
+            RaggedNested,
+            lambda rn: ((rn.data, rn.sub_lengths, rn.tok_lengths), None),
+            lambda aux, ch: RaggedNested(*ch))
     except Exception:
         pass
 
@@ -118,6 +177,59 @@ class LoDTensor:
     def from_padded(cls, padded: np.ndarray, lengths: np.ndarray) -> "LoDTensor":
         seqs = [padded[i, :int(l)] for i, l in enumerate(lengths)]
         return cls.from_sequences(seqs)
+
+    # ---- two-level (nested) conversions ---------------------------------
+    @classmethod
+    def from_nested_sequences(
+            cls, nested: List[List[np.ndarray]]) -> "LoDTensor":
+        """nested[i][j] = tokens of sub-sequence j of outer sequence i."""
+        subs = [np.asarray(s) for outer in nested for s in outer]
+        flat = np.concatenate(subs, axis=0) if subs else np.zeros((0,))
+        lod0 = lengths_to_lod([len(outer) for outer in nested])
+        lod1 = lengths_to_lod([len(s) for s in subs])
+        return cls(flat, [lod0, lod1])
+
+    def nested_sequences(self) -> List[List[np.ndarray]]:
+        if len(self.lod) != 2:
+            raise ValueError("nested_sequences needs exactly 2-level LoD "
+                             f"(got {len(self.lod)} level(s))")
+        outer_off, inner_off = self.lod[0], self.lod[1]
+        out = []
+        for i in range(len(outer_off) - 1):
+            subs = []
+            for j in range(outer_off[i], outer_off[i + 1]):
+                subs.append(self.data[inner_off[j]:inner_off[j + 1]])
+            out.append(subs)
+        return out
+
+    def to_nested_padded(self, max_sub: Optional[int] = None,
+                         max_tok: Optional[int] = None):
+        """-> (data [n, max_sub, max_tok, *feat], sub_lengths int32 [n],
+        tok_lengths int32 [n, max_sub])."""
+        nested = self.nested_sequences()
+        n = len(nested)
+        sub_lengths = np.asarray([len(o) for o in nested], dtype=np.int32)
+        ms = int(max_sub or (sub_lengths.max() if n else 0))
+        tok_lengths = np.zeros((n, ms), dtype=np.int32)
+        for i, outer in enumerate(nested):
+            for j, s in enumerate(outer):
+                tok_lengths[i, j] = len(s)
+        mt = int(max_tok or (tok_lengths.max() if tok_lengths.size else 0))
+        feat = self.data.shape[1:]
+        out = np.zeros((n, ms, mt) + tuple(feat), dtype=self.data.dtype)
+        for i, outer in enumerate(nested):
+            for j, s in enumerate(outer):
+                out[i, j, :len(s)] = s
+        return out, sub_lengths, tok_lengths
+
+    @classmethod
+    def from_nested_padded(cls, data: np.ndarray, sub_lengths: np.ndarray,
+                           tok_lengths: np.ndarray) -> "LoDTensor":
+        nested = [
+            [data[i, j, :int(tok_lengths[i, j])]
+             for j in range(int(sub_lengths[i]))]
+            for i in range(data.shape[0])]
+        return cls.from_nested_sequences(nested)
 
     def __repr__(self):
         return f"LoDTensor(shape={self.data.shape}, lod={self.lod})"

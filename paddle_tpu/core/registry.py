@@ -98,7 +98,7 @@ def run_op(op, env: Dict[str, Any], extra: Optional[Dict] = None
     LoD propagation rule ("output lod = input lod", lod_tensor.md) maps to
     the padded TPU representation.
     """
-    from .lod import RaggedPair  # local import: lod has no registry dep
+    from .lod import RaggedNested, RaggedPair  # local: lod has no registry dep
 
     opdef = OpRegistry.get(op.type)
     if opdef.ragged_aware:
@@ -106,12 +106,12 @@ def run_op(op, env: Dict[str, Any], extra: Optional[Dict] = None
         opdef.compute(ctx)
         return ctx.outputs
 
-    ragged_src: Optional[RaggedPair] = None
+    ragged_src = None
     local = env
     needs_copy = False
     for name in op.input_names():
         v = env.get(name)
-        if isinstance(v, RaggedPair):
+        if isinstance(v, (RaggedPair, RaggedNested)):
             needs_copy = True
             if ragged_src is None:
                 ragged_src = v
@@ -119,18 +119,27 @@ def run_op(op, env: Dict[str, Any], extra: Optional[Dict] = None
         local = dict(env)
         for name in op.input_names():
             v = local.get(name)
-            if isinstance(v, RaggedPair):
+            if isinstance(v, (RaggedPair, RaggedNested)):
                 local[name] = v.data
     ctx = ExecutionContext(op, local, extra)
     opdef.compute(ctx)
     if ragged_src is None:
         return ctx.outputs
-    nt = ragged_src.data.shape[:2]
+    # lod propagation ("output lod = input lod"): re-wrap outputs whose
+    # leading (batch, time[, sub-time]) dims match the first ragged input
+    nested = isinstance(ragged_src, RaggedNested)
+    lead = 3 if nested else 2
+    nt = ragged_src.data.shape[:lead]
     outputs = {}
     for k, v in ctx.outputs.items():
-        if hasattr(v, "ndim") and v.ndim >= 2 and tuple(v.shape[:2]) == nt \
-                and not isinstance(v, RaggedPair):
-            outputs[k] = RaggedPair(v, ragged_src.lengths)
+        if hasattr(v, "ndim") and v.ndim >= lead \
+                and tuple(v.shape[:lead]) == nt \
+                and not isinstance(v, (RaggedPair, RaggedNested)):
+            if nested:
+                outputs[k] = RaggedNested(v, ragged_src.sub_lengths,
+                                          ragged_src.tok_lengths)
+            else:
+                outputs[k] = RaggedPair(v, ragged_src.lengths)
         else:
             outputs[k] = v
     return outputs

@@ -179,6 +179,7 @@ class Block:
 # batch dim (-1) maps to a distinctive dummy extent and back.
 _DUMMY_BATCH = 97
 _DUMMY_TIME = 13
+_DUMMY_SUB = 7
 
 
 def _infer_shapes(block_desc: ir.BlockDesc, op: ir.OpDesc) -> None:
@@ -193,7 +194,7 @@ def _infer_shapes(block_desc: ir.BlockDesc, op: ir.OpDesc) -> None:
 def _infer_shapes_impl(block_desc: ir.BlockDesc, op: ir.OpDesc) -> None:
     import jax
     import jax.numpy as jnp
-    from .core.lod import RaggedPair
+    from .core.lod import RaggedNested, RaggedPair
     from .ops.core_ops import jnp_dtype
 
     env = {}
@@ -206,7 +207,13 @@ def _infer_shapes_impl(block_desc: ir.BlockDesc, op: ir.OpDesc) -> None:
             continue
         shape = [(_DUMMY_BATCH if d == -1 else int(d)) for d in v.shape]
         dt = jnp_dtype(v.dtype)
-        if v.lod_level > 0:
+        if v.lod_level >= 2:
+            data = jax.ShapeDtypeStruct(
+                tuple([shape[0], _DUMMY_SUB, _DUMMY_TIME] + shape[1:]), dt)
+            sub_l = jax.ShapeDtypeStruct((shape[0],), jnp.int32)
+            tok_l = jax.ShapeDtypeStruct((shape[0], _DUMMY_SUB), jnp.int32)
+            env[name] = RaggedNested(data, sub_l, tok_l)
+        elif v.lod_level > 0:
             data = jax.ShapeDtypeStruct(
                 tuple([shape[0], _DUMMY_TIME] + shape[1:]), dt)
             lengths = jax.ShapeDtypeStruct((shape[0],), jnp.int32)
@@ -228,8 +235,20 @@ def _infer_shapes_impl(block_desc: ir.BlockDesc, op: ir.OpDesc) -> None:
         v = block_desc.find_var_recursive(name)
         if v is None:
             continue
-        if isinstance(aval, RaggedPair):
+        if isinstance(aval, RaggedNested):
             shape = [(-1 if d == _DUMMY_BATCH else int(d))
+                     for i, d in enumerate(aval.data.shape)
+                     if i not in (1, 2)]
+            if v.shape is None:
+                v.shape = shape
+            v.lod_level = max(v.lod_level, 2)
+            if v.dtype is None:
+                v.dtype = str(aval.data.dtype)
+        elif isinstance(aval, RaggedPair):
+            # a ragged batch dim may come from flattening a nested batch
+            # (n*max_sub): map any non-static leading dim back to -1
+            shape = [(-1 if d in (_DUMMY_BATCH, _DUMMY_BATCH * _DUMMY_SUB)
+                      else int(d))
                      for i, d in enumerate(aval.data.shape) if i != 1]
             if v.shape is None:
                 v.shape = shape
@@ -237,7 +256,8 @@ def _infer_shapes_impl(block_desc: ir.BlockDesc, op: ir.OpDesc) -> None:
             if v.dtype is None:
                 v.dtype = str(aval.data.dtype)
         else:
-            shape = [(-1 if d == _DUMMY_BATCH else int(d))
+            shape = [(-1 if d in (_DUMMY_BATCH, _DUMMY_BATCH * _DUMMY_SUB)
+                      else int(d))
                      for d in aval.shape]
             if v.shape is None:
                 v.shape = shape

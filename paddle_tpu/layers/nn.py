@@ -23,6 +23,7 @@ __all__ = [
     "topk", "sequence_pool", "sequence_conv", "sequence_softmax",
     "sequence_expand", "sequence_first_step", "sequence_last_step",
     "sequence_reshape", "sequence_mask", "sequence_pad", "sequence_unpad",
+    "nested_sequence_flatten", "nested_sequence_pack",
     "im2sequence", "matmul", "mul", "softmax", "log_softmax", "relu", "lrn",
     "l2_normalize", "mean", "reduce_sum", "reduce_mean", "reduce_max",
     "reduce_min", "reduce_prod", "warpctc", "nce", "smooth_l1", "one_hot_v2",
@@ -458,6 +459,30 @@ def sequence_last_step(input):
     helper = LayerHelper("sequence_last_step")
     out = helper.create_tmp_variable(input.dtype)
     helper.append_op(type="sequence_last_step", inputs={"X": input},
+                     outputs={"Out": out})
+    return out
+
+
+def nested_sequence_flatten(input):
+    """Level-2 ragged (paragraph->sentence->token) -> level-1 ragged batch
+    of sub-sequences. See ops/sequence_ops.py nested_sequence_flatten."""
+    helper = LayerHelper("nested_sequence_flatten")
+    out = helper.create_tmp_variable(input.dtype, lod_level=1)
+    helper.append_op(type="nested_sequence_flatten", inputs={"X": input},
+                     outputs={"Out": out})
+    return out
+
+
+def nested_sequence_pack(input, ref):
+    """Per-sub-sequence dense rows -> level-1 ragged over the outer level
+    of `ref` (a level-2 ragged variable)."""
+    helper = LayerHelper("nested_sequence_pack")
+    # batch dim becomes the outer level; feature dims carry over (shape
+    # inference can't see that input's batch is n*max_sub of ref)
+    shape = ([-1] + list(input.shape[1:])) if input.shape else None
+    out = helper.create_tmp_variable(input.dtype, lod_level=1, shape=shape)
+    helper.append_op(type="nested_sequence_pack",
+                     inputs={"X": input, "Ref": ref},
                      outputs={"Out": out})
     return out
 

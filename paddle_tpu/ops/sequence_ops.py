@@ -16,7 +16,7 @@ import os
 import jax
 import jax.numpy as jnp
 
-from ..core.lod import RaggedPair
+from ..core.lod import RaggedNested, RaggedPair
 from functools import partial
 
 from ..core.registry import register_op
@@ -28,15 +28,19 @@ register_op_SEQ = partial(register_op, ragged_aware=True)
 def _as_ragged(x) -> RaggedPair:
     if isinstance(x, RaggedPair):
         return x
+    if isinstance(x, RaggedNested):
+        raise ValueError(
+            "this sequence op works on level-1 ragged input but got a "
+            "2-level (nested) ragged value — reduce the token level first "
+            "(sequence_pool / sequence_last_step) or flatten it with "
+            "nested_sequence_flatten")
     # Dense [n, t, ...] with all lengths = t.
     lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
     return RaggedPair(x, lengths)
 
 
-@register_op_SEQ("sequence_pool")
-def _sequence_pool(ctx):
-    x = _as_ragged(ctx.input("X"))
-    ptype = ctx.attr("pooltype", "AVERAGE").upper()
+def _pool_padded(x: RaggedPair, ptype: str):
+    """Pool the time axis of a level-1 ragged batch -> dense [n, *feat]."""
     data, lengths = x.data, x.lengths
     mask = x.mask()
     for _ in range(data.ndim - 2):
@@ -64,7 +68,57 @@ def _sequence_pool(ctx):
         out = data[:, 0]
     else:
         raise ValueError(f"unknown pooltype {ptype}")
-    ctx.set_output("Out", out)
+    return out
+
+
+def _pool_nested(x: RaggedNested, ptype: str) -> RaggedPair:
+    """Pool the innermost (token) level of a 2-level ragged batch; the
+    result keeps the outer level (reference LoD semantics: pooling one
+    level of a 2-level LoDTensor yields a 1-level LoDTensor)."""
+    flat = x.flatten()
+    out_flat = _pool_padded(flat, ptype)
+    n, s = x.data.shape[:2]
+    out = out_flat.reshape((n, s) + out_flat.shape[1:])
+    return RaggedPair(out, x.sub_lengths)
+
+
+@register_op_SEQ("sequence_pool")
+def _sequence_pool(ctx):
+    x = ctx.input("X")
+    ptype = ctx.attr("pooltype", "AVERAGE").upper()
+    if isinstance(x, RaggedNested):
+        ctx.set_output("Out", _pool_nested(x, ptype))
+        return
+    ctx.set_output("Out", _pool_padded(_as_ragged(x), ptype))
+
+
+@register_op_SEQ("nested_sequence_flatten")
+def _nested_sequence_flatten(ctx):
+    """2-level ragged [n, max_sub, max_tok, ...] -> level-1 ragged batch of
+    n*max_sub sub-sequences (padding slots have length 0). The inner level
+    of the reference's nested RecurrentGradientMachine loop becomes one
+    masked batch that RNN/sequence ops consume directly."""
+    x = ctx.input("X")
+    if not isinstance(x, RaggedNested):
+        raise ValueError("nested_sequence_flatten needs a 2-level ragged "
+                         "input (feed a LoDTensor with two LoD levels)")
+    ctx.set_output("Out", x.flatten())
+
+
+@register_op_SEQ("nested_sequence_pack", no_grad_slots=["Ref"])
+def _nested_sequence_pack(ctx):
+    """Dense per-sub-sequence rows [n*max_sub, *feat] (e.g. the inner
+    encoder's last states) -> level-1 ragged [n, max_sub, *feat] with the
+    outer lengths of Ref. Inverse of nested_sequence_flatten after the
+    token level is reduced away."""
+    x = ctx.input("X")
+    ref = ctx.input("Ref")
+    if not isinstance(ref, RaggedNested):
+        raise ValueError("nested_sequence_pack needs a 2-level ragged Ref")
+    xd = x.data if isinstance(x, RaggedPair) else x
+    n, s = ref.data.shape[:2]
+    out = xd.reshape((n, s) + xd.shape[1:])
+    ctx.set_output("Out", RaggedPair(out, ref.sub_lengths))
 
 
 @register_op_SEQ("sequence_softmax")
@@ -441,18 +495,20 @@ def _sequence_unpad(ctx):
 
 @register_op_SEQ("sequence_last_step")
 def _sequence_last_step(ctx):
-    x = _as_ragged(ctx.input("X"))
-    idx = jnp.maximum(x.lengths - 1, 0)
-    out = jnp.take_along_axis(
-        x.data, idx.reshape((-1, 1) + (1,) * (x.data.ndim - 2)), axis=1
-    ).squeeze(1)
-    ctx.set_output("Out", out)
+    x = ctx.input("X")
+    if isinstance(x, RaggedNested):
+        ctx.set_output("Out", _pool_nested(x, "LAST"))
+        return
+    ctx.set_output("Out", _pool_padded(_as_ragged(x), "LAST"))
 
 
 @register_op_SEQ("sequence_first_step")
 def _sequence_first_step(ctx):
-    x = _as_ragged(ctx.input("X"))
-    ctx.set_output("Out", x.data[:, 0])
+    x = ctx.input("X")
+    if isinstance(x, RaggedNested):
+        ctx.set_output("Out", _pool_nested(x, "FIRST"))
+        return
+    ctx.set_output("Out", _pool_padded(_as_ragged(x), "FIRST"))
 
 
 # -- CTC (reference: warpctc_op.cc wraps the warp-ctc CUDA lib;
