@@ -60,8 +60,7 @@ class TruncatedNormalInitializer(Initializer):
                                "seed": seed})
 
 
-def _fan_in_out(var):
-    shape = var.shape
+def fan_in_out_from_shape(shape):
     if len(shape) < 2:
         return int(shape[0]), int(shape[0])
     if len(shape) == 2:
@@ -70,6 +69,10 @@ def _fan_in_out(var):
     for d in shape[2:]:
         receptive *= int(d)
     return int(shape[1]) * receptive, int(shape[0]) * receptive
+
+
+def _fan_in_out(var):
+    return fan_in_out_from_shape(var.shape)
 
 
 class XavierInitializer(Initializer):

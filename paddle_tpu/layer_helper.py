@@ -40,6 +40,13 @@ class ParamAttr:
         raise TypeError(f"bad param_attr {arg!r}")
 
 
+# Active parameter-stacking contexts (innermost last). While a
+# PipelinedStack block is being built, every parameter created inside it
+# gets a leading per-stage dim and is recorded — see
+# layers/control_flow.py PipelinedStack.
+_PARAM_STACK_CTX: list = []
+
+
 class LayerHelper:
     def __init__(self, layer_type: str, **kwargs):
         self.kwargs = kwargs
@@ -85,6 +92,39 @@ class LayerHelper:
         else:
             init = XavierInitializer()
         name = attr.name or unique_name(f"{self.name}.w")
+        if _PARAM_STACK_CTX:
+            n_stages, record = _PARAM_STACK_CTX[-1]
+            # fan-sensitive initializers must scale from the PER-STAGE
+            # shape, not the stacked [n_stages, ...] one (each stage is
+            # an independent layer)
+            from .initializer import MSRAInitializer as _MSRA, \
+                NumpyArrayInitializer as _NpInit, \
+                XavierInitializer as _Xavier, fan_in_out_from_shape
+            if isinstance(init, _NpInit):
+                # value-carrying init: the array must already be stacked
+                # per stage, else the scope would hold an unstacked array
+                # and p[i] would slice the wrong axis
+                if list(init.value.shape) != [n_stages] + list(shape):
+                    raise ValueError(
+                        "NumpyArrayInitializer inside a PipelinedStack "
+                        f"block must provide a stacked array of shape "
+                        f"{[n_stages] + list(shape)} (one slice per "
+                        f"stage); got {list(init.value.shape)}")
+            f_in, f_out = fan_in_out_from_shape(list(shape))
+            if isinstance(init, _Xavier):
+                init = _Xavier(
+                    uniform=init.uniform,
+                    fan_in=init.fan_in if init.fan_in is not None else f_in,
+                    fan_out=init.fan_out if init.fan_out is not None
+                    else f_out,
+                    seed=init.seed)
+            elif isinstance(init, _MSRA):
+                init = _MSRA(
+                    uniform=init.uniform,
+                    fan_in=init.fan_in if init.fan_in is not None else f_in,
+                    seed=init.seed)
+            shape = [n_stages] + list(shape)
+            record(name)
         # Parameter lives in BOTH programs: init op in startup, var in main.
         startup_block = self.startup_program.global_block()
         sp = startup_block.create_parameter(name=name, shape=shape,

@@ -15,6 +15,7 @@ from ..framework import Variable, default_main_program
 from ..layer_helper import LayerHelper
 
 __all__ = ["StaticRNN", "DynamicRNN", "IfElse", "While", "Switch",
+           "PipelinedStack",
            "increment_shared", "array_write", "array_read", "array_length",
            "less_than_v", "cond_op"]
 
@@ -490,3 +491,123 @@ def cond_op(pred, true_fn, false_fn):
                             "true_out": true_out.name,
                             "false_out": false_out.name})
     return out
+
+
+class PipelinedStack:
+    """Program-level GPipe pipeline parallelism (beyond reference parity;
+    the reference's closest relative is layer-device model parallelism,
+    ParallelNeuralNetwork.h:34).
+
+    Builds ONE stage body as a sub-block; every parameter created inside
+    gets a leading [n_stages] dim (one slice per stage — the stacked
+    tensor is one random draw, so stages initialize independently). At
+    run time the executor lowers the op to parallel/pipeline.py
+    pipeline_apply over the mesh's `pipe` axis (microbatched,
+    ppermute activation hops); without a mesh carrying that axis the
+    stages run sequentially on one device — same math, same gradients.
+
+        pipe = PipelinedStack(n_stages=4, n_micro=8)
+        with pipe.block():
+            x = pipe.stage_input(h)       # [batch, d]
+            y = layers.fc(x, size=d, act="relu")   # stage body, d -> d
+            pipe.stage_output(y)
+        out = pipe()                      # [batch, d]
+
+    Constraint (standard GPipe-over-ICI): the stage body maps activations
+    of one fixed shape to the same shape (transformer-block style).
+    """
+
+    def __init__(self, n_stages: int, n_micro: int = 1, axis: str = "pipe",
+                 name=None):
+        if n_stages < 1:
+            raise ValueError(f"n_stages must be >= 1 (got {n_stages})")
+        if n_micro < 1:
+            raise ValueError(f"n_micro must be >= 1 (got {n_micro})")
+        self.helper = LayerHelper("pipeline", name=name)
+        self.n_stages = n_stages
+        self.n_micro = n_micro
+        self.axis = axis
+        self._param_names: List[str] = []
+        self._in_outer = None
+        self._in_stage = None
+        self._out_stage = None
+        self._block = None
+        self._parent_prog = None
+
+    class _Guard:
+        def __init__(self, pipe):
+            self.pipe = pipe
+
+        def __enter__(self):
+            from ..layer_helper import _PARAM_STACK_CTX
+            if _PARAM_STACK_CTX:
+                raise NotImplementedError(
+                    "nested PipelinedStack blocks are not supported — "
+                    "compose stages inside one pipeline body instead")
+            prog = default_main_program()
+            self.pipe._parent_prog = prog
+            self.pipe._block = prog.create_block()
+            _PARAM_STACK_CTX.append(
+                (self.pipe.n_stages, self.pipe._param_names.append))
+            return self.pipe
+
+        def __exit__(self, exc_type, *exc):
+            from ..layer_helper import _PARAM_STACK_CTX
+            _PARAM_STACK_CTX.pop()
+            self.pipe._parent_prog.rollback()
+            if exc_type is None:
+                self.pipe._finalize()
+            return False
+
+    def block(self):
+        return PipelinedStack._Guard(self)
+
+    def stage_input(self, x: Variable) -> Variable:
+        if self._in_outer is not None:
+            raise ValueError("PipelinedStack takes exactly one stage_input")
+        self._in_outer = x
+        self._in_stage = self._block.create_var(
+            name=f"{x.name}@stage_in",
+            shape=list(x.shape) if x.shape else None, dtype=x.dtype)
+        return self._in_stage
+
+    def stage_output(self, y: Variable):
+        if self._out_stage is not None:
+            raise ValueError("PipelinedStack takes exactly one stage_output")
+        self._out_stage = y
+
+    def _finalize(self):
+        if self._in_outer is None or self._out_stage is None:
+            raise ValueError("PipelinedStack block needs stage_input() and "
+                             "stage_output()")
+        in_shape = self._in_stage.shape
+        out_shape = self._out_stage.shape
+        if in_shape and out_shape and \
+                list(in_shape[1:]) != list(out_shape[1:]):
+            raise ValueError(
+                "PipelinedStack stage body must map activations to the "
+                f"SAME shape (stage chaining): input {list(in_shape)} vs "
+                f"output {list(out_shape)}")
+        helper = self.helper
+        parent = self._parent_prog.global_block()
+        out = helper.create_tmp_variable(
+            self._out_stage.dtype,
+            shape=list(self._in_outer.shape) if self._in_outer.shape
+            else None)
+        helper.append_op(
+            type="pipeline",
+            inputs={"X": self._in_outer,
+                    "StageParams": [parent.var(n)
+                                    for n in self._param_names]},
+            outputs={"Out": out},
+            attrs={"sub_block_idx": self._block.idx,
+                   "stage_in_name": self._in_stage.name,
+                   "stage_out_name": self._out_stage.name,
+                   "param_names": list(self._param_names),
+                   "n_stages": self.n_stages,
+                   "n_micro": self.n_micro,
+                   "axis": self.axis})
+        self._result = out
+
+    def __call__(self):
+        return self._result

@@ -240,3 +240,49 @@ def _if_else(ctx):
         m = c.reshape((-1,) + (1,) * (tv.ndim - 1))
         merged.append(jnp.where(m, tv, fv))
     ctx.set_outputs("Out", merged)
+
+
+@register_op_CF("pipeline")
+def _pipeline(ctx):
+    """Program-level GPipe pipeline (layers/control_flow.py
+    PipelinedStack). With a mesh carrying the pipe axis: microbatched
+    pipeline_apply (ppermute activation hops inside one scan, stage
+    params sharded stage-per-device). Without one: sequential stage
+    composition — identical math and gradients, so single-device
+    Executors and the ParallelExecutor run the same program."""
+    x = ctx.input("X")
+    params = ctx.inputs("StageParams")
+    names = ctx.attr("param_names")
+    n_stages = ctx.attr("n_stages")
+    n_micro = ctx.attr("n_micro", 1)
+    axis = ctx.attr("axis", "pipe")
+    blk_idx = ctx.attr("sub_block_idx")
+    sin = ctx.attr("stage_in_name")
+    sout = ctx.attr("stage_out_name")
+    outer = dict(ctx.env)
+
+    def stage_fn(pdict, a):
+        env = dict(outer)
+        env.update(pdict)
+        env[sin] = a
+        env = _trace_sub(ctx, blk_idx, env)
+        return env[sout]
+
+    mesh = ctx.extra.get("mesh")
+    if mesh is not None and axis in mesh.axis_names:
+        if mesh.shape[axis] != n_stages:
+            raise ValueError(
+                f"pipeline has n_stages={n_stages} but mesh axis "
+                f"{axis!r} spans {mesh.shape[axis]} devices")
+        from ..parallel.pipeline import (merge_microbatches, pipeline_apply,
+                                         split_microbatches)
+        micro = split_microbatches(x, n_micro)
+        stacked = dict(zip(names, params))
+        out = pipeline_apply(stage_fn, stacked, micro, axis=axis, mesh=mesh)
+        out = merge_microbatches(out)
+    else:
+        a = x
+        for i in range(n_stages):
+            a = stage_fn({n: p[i] for n, p in zip(names, params)}, a)
+        out = a
+    ctx.set_output("Out", out)

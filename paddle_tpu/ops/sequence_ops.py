@@ -115,9 +115,14 @@ def _nested_sequence_pack(ctx):
     ref = ctx.input("Ref")
     if not isinstance(ref, RaggedNested):
         raise ValueError("nested_sequence_pack needs a 2-level ragged Ref")
-    xd = x.data if isinstance(x, RaggedPair) else x
+    if isinstance(x, (RaggedPair, RaggedNested)):
+        raise ValueError(
+            "nested_sequence_pack expects DENSE per-sub-sequence rows "
+            "[n*max_sub, *feat]; got a ragged value whose token level is "
+            "still present — reduce it first (sequence_last_step / "
+            "sequence_pool)")
     n, s = ref.data.shape[:2]
-    out = xd.reshape((n, s) + xd.shape[1:])
+    out = x.reshape((n, s) + x.shape[1:])
     ctx.set_output("Out", RaggedPair(out, ref.sub_lengths))
 
 
