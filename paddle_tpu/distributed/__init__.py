@@ -12,3 +12,5 @@ from .checkpoint import (  # noqa: F401
     save_checkpoint, load_checkpoint, latest_checkpoint)
 from .multihost import (  # noqa: F401
     cluster_env, init_multihost, make_multihost_mesh)
+from .pserver import (  # noqa: F401
+    AsyncParameterServer, PServerServer, PServerClient)

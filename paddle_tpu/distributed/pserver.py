@@ -1,0 +1,423 @@
+"""Asynchronous parameter-server semantics.
+
+Reference capability: ParameterServer2's sync `addGradient`
+(ParameterServer2.h:482 — fan-in barrier, then one optimizer step on the
+mean gradient), async `asyncSGD` (:468 — each trainer's gradient is
+applied immediately, no barrier; trainers read whatever params are
+current), and sparse row-subset pull (`getParameterSparse` :510); the Go
+pserver mirrors the same surface (go/pserver/service.go:229-311) with
+elastic checkpoints.
+
+TPU-native stance (SURVEY §2 strategy table): DENSE synchronous training
+does not use this — it is SPMD collectives over ICI (ParallelExecutor).
+What collectives cannot express is *asynchrony*: updates applied without
+a step barrier, stale reads, elastic trainer membership. That state
+mutation is host-side by nature, so this is a host service: parameters
+live in pinned host numpy arrays behind per-parameter locks, trainers
+(threads or TCP peers) push grads / pull params at their own pace, and
+sparse pushes touch only the rows a trainer saw (SelectedRows-gradient
+semantics).
+"""
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import socketserver
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["AsyncParameterServer", "PServerServer", "PServerClient"]
+
+
+class _HostOptimizer:
+    """Per-parameter host update rules (reference: the pserver applies
+    optimizer steps server-side — ParameterServer2 doOperation :383,
+    go/pserver optimizer.go via paddle/optimizer)."""
+
+    def __init__(self, kind: str = "sgd", lr: float = 0.01,
+                 momentum: float = 0.9, epsilon: float = 1e-6):
+        if kind not in ("sgd", "momentum", "adagrad"):
+            raise ValueError(f"unknown host optimizer {kind!r}")
+        self.kind = kind
+        self.lr = lr
+        self.momentum = momentum
+        self.epsilon = epsilon
+
+    def make_state(self, value: np.ndarray) -> Dict[str, np.ndarray]:
+        if self.kind == "momentum":
+            return {"velocity": np.zeros_like(value)}
+        if self.kind == "adagrad":
+            return {"moment": np.zeros_like(value)}
+        return {}
+
+    def apply_dense(self, value, state, grad):
+        if self.kind == "sgd":
+            value -= self.lr * grad
+        elif self.kind == "momentum":
+            v = state["velocity"]
+            v *= self.momentum
+            v += grad
+            value -= self.lr * v
+        else:  # adagrad
+            m = state["moment"]
+            m += grad * grad
+            value -= self.lr * grad / (np.sqrt(m) + self.epsilon)
+
+    def apply_sparse(self, value, state, rows, grad_rows):
+        """Update only the touched rows (SelectedRows semantics —
+        reference: selected_rows_functor + sparse pserver path).
+        Duplicate row ids are segment-summed first, as the reference's
+        MergeAdd functor does — each row gets ONE optimizer step on its
+        total gradient."""
+        uniq, inv = np.unique(np.asarray(rows, np.int64),
+                              return_inverse=True)
+        g = np.zeros((len(uniq),) + grad_rows.shape[1:],
+                     dtype=grad_rows.dtype)
+        np.add.at(g, inv, grad_rows)
+        if self.kind == "sgd":
+            value[uniq] -= self.lr * g
+        elif self.kind == "momentum":
+            v = state["velocity"]
+            v[uniq] = self.momentum * v[uniq] + g
+            value[uniq] -= self.lr * v[uniq]
+        else:  # adagrad
+            m = state["moment"]
+            m[uniq] += g * g
+            value[uniq] -= self.lr * g / (np.sqrt(m[uniq]) + self.epsilon)
+
+
+class AsyncParameterServer:
+    """In-process async/sync parameter service.
+
+    Modes per push:
+      - push_grad(..., sync=False): asyncSGD — apply under the param lock
+        immediately; no coordination between trainers.
+      - push_grad(..., sync=True, num_trainers=N): addGradient — block
+        until N trainers contribute for this param/round, apply the MEAN
+        gradient once, release everyone (the reference's fan-in batch
+        barrier, listen_and_serv_op.cc:119-137).
+    """
+
+    def __init__(self, optimizer: str = "sgd", lr: float = 0.01,
+                 momentum: float = 0.9, epsilon: float = 1e-6,
+                 sync_timeout_s: Optional[float] = None):
+        self._opt = _HostOptimizer(optimizer, lr=lr, momentum=momentum,
+                                   epsilon=epsilon)
+        # fan-in barrier guard: if a peer dies mid-round, waiters abort
+        # after this long and the round resets (None = wait forever)
+        self._sync_timeout = sync_timeout_s
+        self._params: Dict[str, np.ndarray] = {}
+        self._state: Dict[str, Dict[str, np.ndarray]] = {}
+        self._locks: Dict[str, threading.Lock] = {}
+        self._versions: Dict[str, int] = {}
+        self._init_done = threading.Event()
+        # sync-mode accumulators: name -> [sum_grad, count, round, cond]
+        self._sync: Dict[str, list] = {}
+        self._global_lock = threading.Lock()
+
+    # -- init protocol (reference: go/pserver InitParam/FinishInitParams,
+    # service.go:229-260; exactly-once init election is the caller's job
+    # via master.request_save_model-style election) --------------------
+    def init_param(self, name: str, value: np.ndarray) -> None:
+        if self._init_done.is_set():
+            raise RuntimeError("init_param after finish_init")
+        arr = np.array(value, copy=True)
+        with self._global_lock:
+            self._params[name] = arr
+            self._state[name] = self._opt.make_state(arr)
+            self._locks[name] = threading.Lock()
+            self._versions[name] = 0
+            self._sync[name] = [None, 0, 0, threading.Condition()]
+
+    def finish_init(self) -> None:
+        self._init_done.set()
+
+    def wait_init(self, timeout: Optional[float] = None) -> bool:
+        """Trainers block here until some peer finished init (reference:
+        go/pserver/client.go paramserver readiness)."""
+        return self._init_done.wait(timeout)
+
+    def param_names(self) -> List[str]:
+        return sorted(self._params)
+
+    # -- pull ----------------------------------------------------------
+    def get_param(self, name: str) -> np.ndarray:
+        with self._locks[name]:
+            return self._params[name].copy()
+
+    def get_param_sparse(self, name: str, rows: Sequence[int]) -> np.ndarray:
+        """Row-subset pull (reference: getParameterSparse,
+        ParameterServer2.h:510 — trainers with sparse updates fetch only
+        rows they need)."""
+        idx = np.asarray(rows, dtype=np.int64)
+        with self._locks[name]:
+            return self._params[name][idx].copy()
+
+    def version(self, name: str) -> int:
+        with self._locks[name]:
+            return self._versions[name]
+
+    # -- push ----------------------------------------------------------
+    def push_grad(self, name: str, grad: np.ndarray, sync: bool = False,
+                  num_trainers: int = 1) -> int:
+        """Apply a dense gradient; returns the post-update version."""
+        self._check(name, grad.shape)
+        if not sync:
+            with self._locks[name]:
+                self._opt.apply_dense(self._params[name],
+                                      self._state[name], grad)
+                self._versions[name] += 1
+                return self._versions[name]
+        acc = self._sync[name]
+        cond: threading.Condition = acc[3]
+        with cond:
+            my_round = acc[2]
+            acc[0] = grad.astype(np.float64) if acc[0] is None \
+                else acc[0] + grad
+            acc[1] += 1
+            if acc[1] >= num_trainers:
+                mean = (acc[0] / acc[1]).astype(self._params[name].dtype)
+                with self._locks[name]:
+                    self._opt.apply_dense(self._params[name],
+                                          self._state[name], mean)
+                    self._versions[name] += 1
+                acc[0], acc[1] = None, 0
+                acc[2] += 1
+                cond.notify_all()
+            else:
+                done = cond.wait_for(lambda: acc[2] > my_round,
+                                     timeout=self._sync_timeout)
+                if not done:
+                    # a peer died mid-round: reset so later rounds are
+                    # not poisoned by this round's partial sum
+                    acc[0], acc[1] = None, 0
+                    raise RuntimeError(
+                        f"sync push barrier for {name!r} timed out after "
+                        f"{self._sync_timeout}s with {num_trainers} "
+                        "trainers expected — round aborted")
+        with self._locks[name]:
+            return self._versions[name]
+
+    def push_grad_sparse(self, name: str, rows: Sequence[int],
+                         grad_rows: np.ndarray) -> int:
+        """Async row-sparse push: only the given rows move."""
+        idx = np.asarray(rows, dtype=np.int64)
+        g = np.asarray(grad_rows)
+        if g.shape[0] != idx.shape[0]:
+            raise ValueError(f"rows ({idx.shape[0]}) and grad_rows "
+                             f"({g.shape[0]}) disagree")
+        with self._locks[name]:
+            self._opt.apply_sparse(self._params[name], self._state[name],
+                                   idx, g)
+            self._versions[name] += 1
+            return self._versions[name]
+
+    def _check(self, name, shape):
+        if name not in self._params:
+            raise KeyError(f"unknown parameter {name!r}")
+        if tuple(shape) != self._params[name].shape:
+            raise ValueError(
+                f"grad shape {tuple(shape)} != param shape "
+                f"{self._params[name].shape} for {name!r}")
+
+    # -- elastic checkpoint (reference: go/pserver service.go:120-205 —
+    # periodic checkpoint with md5-verified metadata; restart resumes
+    # from it) ---------------------------------------------------------
+    def save_checkpoint(self, directory: str) -> str:
+        import os
+        with self._global_lock:
+            blobs = {}
+            for n in self._params:
+                with self._locks[n]:
+                    blobs[n] = self._params[n].copy()
+                    for k, v in self._state[n].items():
+                        blobs[f"{n}@{k}"] = v.copy()
+        from .checkpoint import _md5
+        os.makedirs(directory, exist_ok=True)
+        data_path = os.path.join(directory, "pserver.npz")
+        tmp = data_path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **blobs)
+        digest = _md5(tmp)  # streaming — no full-payload read
+        os.replace(tmp, data_path)
+        meta = os.path.join(directory, "pserver.meta.json")
+        with open(meta + ".tmp", "w") as f:
+            json.dump({"md5": digest, "names": sorted(blobs)}, f)
+        os.replace(meta + ".tmp", meta)
+        return data_path
+
+    def load_checkpoint(self, directory: str) -> None:
+        import os
+        from .checkpoint import _md5
+        data_path = os.path.join(directory, "pserver.npz")
+        meta_path = os.path.join(directory, "pserver.meta.json")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        if _md5(data_path) != meta["md5"]:
+            raise IOError(f"checkpoint {data_path} fails md5 verification")
+        blobs = np.load(data_path)
+        with self._global_lock:
+            for n in blobs.files:
+                v = blobs[n]
+                if "@" in n:
+                    base, k = n.split("@", 1)
+                    self._state.setdefault(base, {})[k] = np.array(v)
+                else:
+                    arr = np.array(v)
+                    self._params[n] = arr
+                    # params without saved state blobs (e.g. sgd) still
+                    # need their optimizer-state dict materialized
+                    self._state.setdefault(n, self._opt.make_state(arr))
+                    self._locks.setdefault(n, threading.Lock())
+                    self._versions.setdefault(n, 0)
+                    self._sync.setdefault(
+                        n, [None, 0, 0, threading.Condition()])
+        self._init_done.set()
+
+
+# -- TCP transport (same JSON-lines idiom as distributed/master.py; the
+# reference speaks a custom socket protocol, LightNetwork.h:40) ---------
+
+def _enc(arr: np.ndarray) -> dict:
+    return {"dtype": str(arr.dtype), "shape": list(arr.shape),
+            "data": base64.b64encode(np.ascontiguousarray(arr)
+                                     .tobytes()).decode()}
+
+
+def _dec(obj: dict) -> np.ndarray:
+    return np.frombuffer(
+        base64.b64decode(obj["data"]), dtype=obj["dtype"]
+    ).reshape(obj["shape"]).copy()
+
+
+class _PSHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        ps: AsyncParameterServer = self.server.ps  # type: ignore
+        for line in self.rfile:
+            try:
+                req = json.loads(line)
+                m = req.get("method")
+                if m == "init_param":
+                    ps.init_param(req["name"], _dec(req["value"]))
+                    resp = {"ok": True}
+                elif m == "finish_init":
+                    ps.finish_init()
+                    resp = {"ok": True}
+                elif m == "wait_init":
+                    resp = {"ok": ps.wait_init(req.get("timeout", 30.0))}
+                elif m == "get_param":
+                    resp = {"value": _enc(ps.get_param(req["name"]))}
+                elif m == "get_param_sparse":
+                    resp = {"value": _enc(ps.get_param_sparse(
+                        req["name"], req["rows"]))}
+                elif m == "push_grad":
+                    resp = {"version": ps.push_grad(
+                        req["name"], _dec(req["grad"]),
+                        sync=req.get("sync", False),
+                        num_trainers=req.get("num_trainers", 1))}
+                elif m == "push_grad_sparse":
+                    resp = {"version": ps.push_grad_sparse(
+                        req["name"], req["rows"], _dec(req["grad_rows"]))}
+                elif m == "param_names":
+                    resp = {"names": ps.param_names()}
+                else:
+                    resp = {"error": f"unknown method {m!r}"}
+            except Exception as e:  # malformed request must not kill server
+                resp = {"error": repr(e)}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class PServerServer:
+    def __init__(self, ps: AsyncParameterServer, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.ps = ps
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _PSHandler)
+        self._server.ps = ps  # type: ignore[attr-defined]
+        self.endpoint = "{}:{}".format(*self._server.server_address)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class PServerClient:
+    """Blocking JSON-lines client (one socket per client; thread-safe)."""
+
+    def __init__(self, endpoint: str, timeout: Optional[float] = None,
+                 connect_timeout: float = 30.0):
+        """timeout=None blocks indefinitely on replies — required for
+        sync (fan-in barrier) pushes, where the reply only arrives once
+        the LAST trainer contributes."""
+        self.endpoint = endpoint
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=connect_timeout)
+        self._sock.settimeout(timeout)
+        self._file = self._sock.makefile("rwb")
+        self._lock = threading.Lock()
+
+    def _call(self, req: dict) -> dict:
+        with self._lock:
+            self._file.write((json.dumps(req) + "\n").encode())
+            self._file.flush()
+            line = self._file.readline()
+        if not line:
+            raise ConnectionError("pserver closed connection")
+        resp = json.loads(line)
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp
+
+    def init_param(self, name, value):
+        self._call({"method": "init_param", "name": name,
+                    "value": _enc(np.asarray(value))})
+
+    def finish_init(self):
+        self._call({"method": "finish_init"})
+
+    def wait_init(self, timeout=30.0) -> bool:
+        return self._call({"method": "wait_init",
+                           "timeout": timeout})["ok"]
+
+    def get_param(self, name) -> np.ndarray:
+        return _dec(self._call({"method": "get_param",
+                                "name": name})["value"])
+
+    def get_param_sparse(self, name, rows) -> np.ndarray:
+        return _dec(self._call({"method": "get_param_sparse", "name": name,
+                                "rows": [int(r) for r in rows]})["value"])
+
+    def push_grad(self, name, grad, sync=False, num_trainers=1) -> int:
+        return self._call({"method": "push_grad", "name": name,
+                           "grad": _enc(np.asarray(grad)), "sync": sync,
+                           "num_trainers": num_trainers})["version"]
+
+    def push_grad_sparse(self, name, rows, grad_rows) -> int:
+        return self._call({"method": "push_grad_sparse", "name": name,
+                           "rows": [int(r) for r in rows],
+                           "grad_rows": _enc(np.asarray(grad_rows))}
+                          )["version"]
+
+    def param_names(self) -> List[str]:
+        return self._call({"method": "param_names"})["names"]
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

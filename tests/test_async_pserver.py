@@ -1,0 +1,251 @@
+"""Async parameter-server semantics (reference: ParameterServer2 asyncSGD
+ParameterServer2.h:468, addGradient :482, getParameterSparse :510; Go
+pserver go/pserver/service.go checkpoint :120-205). See
+paddle_tpu/distributed/pserver.py for the TPU-native design stance."""
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import (AsyncParameterServer, PServerClient,
+                                    PServerServer)
+
+
+def test_async_sgd_multitrainer_converges():
+    ps = AsyncParameterServer(optimizer="sgd", lr=0.05)
+    target = np.array([1.0, -2.0, 3.0], np.float32)
+    ps.init_param("w", np.zeros(3, np.float32))
+    ps.finish_init()
+
+    def trainer(seed):
+        rng = np.random.RandomState(seed)
+        assert ps.wait_init(5.0)
+        for _ in range(200):
+            w = ps.get_param("w")
+            grad = 2.0 * (w - target) + rng.randn(3).astype(np.float32) * 0.05
+            ps.push_grad("w", grad)          # async: no barrier
+
+    ts = [threading.Thread(target=trainer, args=(s,)) for s in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    w = ps.get_param("w")
+    np.testing.assert_allclose(w, target, atol=0.05)
+    assert ps.version("w") == 4 * 200
+
+
+def test_sync_push_applies_mean_once():
+    ps = AsyncParameterServer(optimizer="sgd", lr=0.1)
+    ps.init_param("w", np.zeros(2, np.float32))
+    ps.finish_init()
+    grads = [np.array([3.0, 0.0], np.float32),
+             np.array([0.0, 3.0], np.float32),
+             np.array([3.0, 3.0], np.float32)]
+
+    def push(g):
+        ps.push_grad("w", g, sync=True, num_trainers=3)
+
+    ts = [threading.Thread(target=push, args=(g,)) for g in grads]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # one optimizer step on the MEAN gradient (fan-in barrier semantics)
+    np.testing.assert_allclose(ps.get_param("w"),
+                               -0.1 * np.array([2.0, 2.0]), atol=1e-6)
+    assert ps.version("w") == 1
+
+
+def test_sparse_push_touches_only_given_rows():
+    ps = AsyncParameterServer(optimizer="sgd", lr=1.0)
+    table = np.ones((6, 4), np.float32)
+    ps.init_param("emb", table)
+    ps.finish_init()
+    rows = [1, 4]
+    g = np.full((2, 4), 0.5, np.float32)
+    ps.push_grad_sparse("emb", rows, g)
+    out = ps.get_param("emb")
+    np.testing.assert_allclose(out[[1, 4]], 0.5)      # 1 - 1.0*0.5
+    np.testing.assert_allclose(out[[0, 2, 3, 5]], 1.0)  # untouched
+    np.testing.assert_allclose(ps.get_param_sparse("emb", rows), 0.5)
+
+
+def test_adagrad_and_momentum_host_rules():
+    for kind in ("adagrad", "momentum"):
+        ps = AsyncParameterServer(optimizer=kind, lr=0.1)
+        ps.init_param("w", np.zeros(2, np.float32))
+        ps.finish_init()
+        for _ in range(300):
+            w = ps.get_param("w")
+            ps.push_grad("w", 2.0 * (w - 1.0))
+        np.testing.assert_allclose(ps.get_param("w"), 1.0, atol=0.1)
+
+
+def test_shape_and_name_validation():
+    ps = AsyncParameterServer()
+    ps.init_param("w", np.zeros((2, 2), np.float32))
+    ps.finish_init()
+    with pytest.raises(KeyError):
+        ps.push_grad("nope", np.zeros((2, 2), np.float32))
+    with pytest.raises(ValueError):
+        ps.push_grad("w", np.zeros((3,), np.float32))
+    with pytest.raises(ValueError):
+        ps.push_grad_sparse("w", [0, 1], np.zeros((3, 2), np.float32))
+
+
+def test_tcp_roundtrip_and_async_training():
+    ps = AsyncParameterServer(optimizer="sgd", lr=0.05)
+    server = PServerServer(ps).start()
+    try:
+        c0 = PServerClient(server.endpoint)
+        c0.init_param("w", np.zeros(3, np.float32))
+        c0.finish_init()
+        target = np.array([0.5, -0.5, 2.0], np.float32)
+
+        def trainer(seed):
+            c = PServerClient(server.endpoint)
+            assert c.wait_init(5.0)
+            assert c.param_names() == ["w"]
+            rng = np.random.RandomState(seed)
+            for _ in range(100):
+                w = c.get_param("w")
+                g = 2.0 * (w - target) + \
+                    rng.randn(3).astype(np.float32) * 0.05
+                c.push_grad("w", g)
+            c.close()
+
+        ts = [threading.Thread(target=trainer, args=(s,))
+              for s in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        np.testing.assert_allclose(c0.get_param("w"), target, atol=0.05)
+        # sparse over TCP
+        c0.init_param  # (init already done; just exercise sparse calls)
+        with pytest.raises(RuntimeError):
+            c0.get_param("missing")
+        c0.close()
+    finally:
+        server.shutdown()
+
+
+def test_checkpoint_roundtrip_and_md5_verification(tmp_path):
+    ps = AsyncParameterServer(optimizer="adagrad", lr=0.1)
+    ps.init_param("w", np.arange(4, dtype=np.float32))
+    ps.finish_init()
+    ps.push_grad("w", np.ones(4, np.float32))
+    path = ps.save_checkpoint(str(tmp_path))
+
+    fresh = AsyncParameterServer(optimizer="adagrad", lr=0.1)
+    fresh.load_checkpoint(str(tmp_path))
+    np.testing.assert_allclose(fresh.get_param("w"), ps.get_param("w"))
+    # optimizer state travels too: next identical push matches
+    ps.push_grad("w", np.ones(4, np.float32))
+    fresh.push_grad("w", np.ones(4, np.float32))
+    np.testing.assert_allclose(fresh.get_param("w"), ps.get_param("w"))
+
+    with open(path, "r+b") as f:
+        f.seek(0)
+        f.write(b"\x00" * 8)
+    broken = AsyncParameterServer()
+    with pytest.raises(IOError):
+        broken.load_checkpoint(str(tmp_path))
+
+
+def test_device_grads_push_async():
+    """End-to-end: trainers compute gradients with a paddle_tpu program
+    (device compute) and push them to the async service — the reference's
+    RemoteParameterUpdater pattern (RemoteParameterUpdater.cpp:108-187)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.core.backward import append_backward
+    from paddle_tpu.core.scope import Scope
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, size=1, bias_attr=False,
+                         param_attr=pt.ParamAttr(name="w_fc"))
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+        pairs = append_backward(loss)
+    grad_name = dict((p if isinstance(p, str) else p.name, g)
+                     for p, g in pairs)["w_fc"]
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(4, 1).astype(np.float32)
+
+    ps = AsyncParameterServer(optimizer="sgd", lr=0.2)
+    ps.init_param("w_fc", np.zeros((4, 1), np.float32))
+    ps.finish_init()
+
+    def trainer(seed):
+        r = np.random.RandomState(seed)
+        exe = pt.Executor()
+        scope = Scope()
+        exe.run(startup, scope=scope)
+        for _ in range(60):
+            xs = r.randn(16, 4).astype(np.float32)
+            ys = xs @ w_true
+            scope.set("w_fc", ps.get_param("w_fc"))   # pull
+            (g,) = exe.run(main, feed={"x": xs, "y": ys},
+                           fetch_list=[grad_name], scope=scope)
+            ps.push_grad("w_fc", np.asarray(g))       # async push
+
+    ts = [threading.Thread(target=trainer, args=(s,)) for s in (1, 2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    np.testing.assert_allclose(ps.get_param("w_fc"), w_true, atol=0.05)
+
+
+def test_sparse_duplicate_rows_accumulate_per_optimizer():
+    """Duplicate row ids segment-sum before the update (SelectedRows
+    MergeAdd semantics) for every host rule."""
+    for kind in ("sgd", "momentum", "adagrad"):
+        ps = AsyncParameterServer(optimizer=kind, lr=1.0, momentum=0.0)
+        ps.init_param("e", np.zeros((3, 1), np.float32))
+        ps.finish_init()
+        ps.push_grad_sparse("e", [1, 1], np.ones((2, 1), np.float32))
+        got = float(ps.get_param("e")[1, 0])
+        if kind == "adagrad":
+            # one step on total grad 2: -lr * 2 / (sqrt(4) + eps) ~ -1
+            np.testing.assert_allclose(got, -1.0, atol=1e-4)
+        else:
+            # sgd / momentum(0): one step on total grad 2
+            np.testing.assert_allclose(got, -2.0, atol=1e-6)
+
+
+def test_sgd_checkpoint_restores_usable_server(tmp_path):
+    ps = AsyncParameterServer(optimizer="sgd", lr=0.5)
+    ps.init_param("w", np.ones(2, np.float32))
+    ps.finish_init()
+    ps.save_checkpoint(str(tmp_path))
+    fresh = AsyncParameterServer(optimizer="sgd", lr=0.5)
+    fresh.load_checkpoint(str(tmp_path))
+    # push and re-checkpoint must both work (state dict materialized)
+    fresh.push_grad("w", np.ones(2, np.float32))
+    np.testing.assert_allclose(fresh.get_param("w"), 0.5)
+    fresh.save_checkpoint(str(tmp_path))
+
+
+def test_sync_barrier_timeout_aborts_and_resets():
+    ps = AsyncParameterServer(optimizer="sgd", lr=1.0,
+                              sync_timeout_s=0.3)
+    ps.init_param("w", np.zeros(1, np.float32))
+    ps.finish_init()
+    with pytest.raises(RuntimeError, match="barrier"):
+        ps.push_grad("w", np.ones(1, np.float32), sync=True,
+                     num_trainers=2)  # nobody else shows up
+    # the aborted round must not poison the next one
+    grads = [np.array([2.0], np.float32), np.array([4.0], np.float32)]
+    ts = [threading.Thread(target=lambda g=g: ps.push_grad(
+        "w", g, sync=True, num_trainers=2)) for g in grads]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    np.testing.assert_allclose(ps.get_param("w"), [-3.0])  # mean(2,4)
