@@ -124,13 +124,17 @@ class AsyncParameterServer:
     def init_param(self, name: str, value: np.ndarray) -> None:
         if self._init_done.is_set():
             raise RuntimeError("init_param after finish_init")
+        if "@" in name:
+            raise ValueError(
+                f"parameter name {name!r} may not contain '@' (reserved "
+                "for optimizer-state blobs in checkpoints)")
         arr = np.array(value, copy=True)
         with self._global_lock:
             self._params[name] = arr
             self._state[name] = self._opt.make_state(arr)
             self._locks[name] = threading.Lock()
             self._versions[name] = 0
-            self._sync[name] = [None, 0, 0, threading.Condition()]
+            self._sync[name] = [None, 0, 0, threading.Condition(), set()]
 
     def finish_init(self) -> None:
         self._init_done.set()
@@ -171,6 +175,7 @@ class AsyncParameterServer:
                                       self._state[name], grad)
                 self._versions[name] += 1
                 return self._versions[name]
+        # acc: [grad_sum, count, round_id, cond, aborted_round_ids]
         acc = self._sync[name]
         cond: threading.Condition = acc[3]
         with cond:
@@ -190,25 +195,45 @@ class AsyncParameterServer:
             else:
                 done = cond.wait_for(lambda: acc[2] > my_round,
                                      timeout=self._sync_timeout)
-                if not done:
-                    # a peer died mid-round: reset so later rounds are
-                    # not poisoned by this round's partial sum
+                if not done and acc[2] == my_round:
+                    # a peer died mid-round: abort THIS round (if a later
+                    # round already started, leave it alone), drop the
+                    # partial sum, and wake co-contributors so they fail
+                    # too instead of being credited into a future round
                     acc[0], acc[1] = None, 0
+                    acc[2] += 1
+                    acc[4].add(my_round)
+                    if len(acc[4]) > 64:
+                        acc[4].discard(min(acc[4]))
+                    cond.notify_all()
+                if my_round in acc[4]:
                     raise RuntimeError(
                         f"sync push barrier for {name!r} timed out after "
                         f"{self._sync_timeout}s with {num_trainers} "
-                        "trainers expected — round aborted")
+                        "trainers expected — round aborted, gradient "
+                        "dropped")
         with self._locks[name]:
             return self._versions[name]
 
     def push_grad_sparse(self, name: str, rows: Sequence[int],
                          grad_rows: np.ndarray) -> int:
         """Async row-sparse push: only the given rows move."""
+        if name not in self._params:
+            raise KeyError(f"unknown parameter {name!r}")
         idx = np.asarray(rows, dtype=np.int64)
         g = np.asarray(grad_rows)
         if g.shape[0] != idx.shape[0]:
             raise ValueError(f"rows ({idx.shape[0]}) and grad_rows "
                              f"({g.shape[0]}) disagree")
+        nrows = self._params[name].shape[0]
+        if idx.size and (idx.min() < 0 or idx.max() >= nrows):
+            raise ValueError(
+                f"row ids out of range for {name!r} with {nrows} rows: "
+                f"[{idx.min()}, {idx.max()}]")
+        if g.shape[1:] != self._params[name].shape[1:]:
+            raise ValueError(
+                f"grad row shape {g.shape[1:]} != param row shape "
+                f"{self._params[name].shape[1:]} for {name!r}")
         with self._locks[name]:
             self._opt.apply_sparse(self._params[name], self._state[name],
                                    idx, g)
@@ -274,7 +299,7 @@ class AsyncParameterServer:
                     self._locks.setdefault(n, threading.Lock())
                     self._versions.setdefault(n, 0)
                     self._sync.setdefault(
-                        n, [None, 0, 0, threading.Condition()])
+                        n, [None, 0, 0, threading.Condition(), set()])
         self._init_done.set()
 
 

@@ -437,11 +437,10 @@ def _gru(ctx):
         ridx = (x.lengths[:, None] - 1 - jnp.arange(t)[None, :]) % t
         data = jnp.take_along_axis(data, ridx[..., None], axis=1)
 
-    # Opt-in (default off): correctness is verified on chip, but a
-    # trustworthy perf A/B was not obtainable through the TPU tunnel's
-    # noisy dispatch — enable once measured on direct hardware.
+    # default ON: measured ~1.8x over the scan path on v5e (20-layer
+    # stacked GRU, b64 t100 h512, marginal-cost protocol, 2 runs each)
     from .pallas import pallas_dispatch
-    enabled, interp = pallas_dispatch("PADDLE_TPU_PALLAS_GRU", "0")
+    enabled, interp = pallas_dispatch("PADDLE_TPU_PALLAS_GRU", "1")
     eligible = (ctx.attr("gate_activation", "sigmoid") == "sigmoid"
                 and ctx.attr("activation", "tanh") == "tanh")
     if enabled and eligible:

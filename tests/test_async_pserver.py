@@ -249,3 +249,45 @@ def test_sync_barrier_timeout_aborts_and_resets():
     for t in ts:
         t.join()
     np.testing.assert_allclose(ps.get_param("w"), [-3.0])  # mean(2,4)
+
+
+def test_sync_barrier_abort_fails_all_contributors():
+    """Co-contributors of a timed-out round must ALL see the failure —
+    nobody's dropped gradient may be reported as applied."""
+    ps = AsyncParameterServer(optimizer="sgd", lr=1.0,
+                              sync_timeout_s=0.4)
+    ps.init_param("w", np.zeros(1, np.float32))
+    ps.finish_init()
+    errors = []
+
+    def push():
+        try:
+            ps.push_grad("w", np.ones(1, np.float32), sync=True,
+                         num_trainers=3)  # third trainer never arrives
+        except RuntimeError as e:
+            errors.append(e)
+
+    ts = [threading.Thread(target=push) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(errors) == 2, errors
+    np.testing.assert_allclose(ps.get_param("w"), [0.0])  # nothing applied
+    assert ps.version("w") == 0
+
+
+def test_param_name_and_sparse_row_validation():
+    ps = AsyncParameterServer()
+    with pytest.raises(ValueError, match="reserved"):
+        ps.init_param("w@state", np.zeros(1, np.float32))
+    ps.init_param("e", np.zeros((4, 3), np.float32))
+    ps.finish_init()
+    with pytest.raises(KeyError):
+        ps.push_grad_sparse("missing", [0], np.zeros((1, 3), np.float32))
+    with pytest.raises(ValueError, match="out of range"):
+        ps.push_grad_sparse("e", [-1], np.zeros((1, 3), np.float32))
+    with pytest.raises(ValueError, match="out of range"):
+        ps.push_grad_sparse("e", [4], np.zeros((1, 3), np.float32))
+    with pytest.raises(ValueError, match="row shape"):
+        ps.push_grad_sparse("e", [0], np.zeros((1, 5), np.float32))
