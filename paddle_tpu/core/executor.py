@@ -32,6 +32,10 @@ STEP_VAR = "@step_counter@"
 
 # Parity with the reference's FLAGS_check_nan_inf (executor.cc:27,345-353).
 CHECK_NAN_INF = os.environ.get("PADDLE_TPU_CHECK_NAN_INF", "0") == "1"
+# Opt-in: raise when a bounded While loop hit its max_steps with the
+# condition still true (costs a per-run host readback of the flags).
+CHECK_WHILE_BOUND = \
+    os.environ.get("PADDLE_TPU_CHECK_WHILE_BOUND", "0") == "1"
 
 
 # Device-side cache for immutable feed arrays. Feeding over a slow host
@@ -247,6 +251,19 @@ class Executor:
                        for f in (fetch_list or [])]
         block = program.block(block_idx)
 
+        n_user_fetches = len(fetch_names)
+        if CHECK_WHILE_BOUND:
+            # Auto-fetch every bounded-While exhaustion flag in this
+            # block (plain temps, not persistable state). Appended even
+            # when the user also fetches one — the checked tail must be
+            # complete. Limitation: a bounded While nested inside another
+            # sub-block keeps its flag block-local; propagate it to a
+            # parent var (assign) to check it here.
+            exhausted = [op.outputs["Exhausted"][0] for op in block.ops
+                         if op.type == "while"
+                         and op.outputs.get("Exhausted")]
+            fetch_names = fetch_names + exhausted
+
         feed_vals = {k: _to_device_value(v) for k, v in feed.items()}
         feed_sig = tuple(sorted((k, _abstractify(v))
                                 for k, v in feed_vals.items()))
@@ -268,6 +285,15 @@ class Executor:
             scope.set(n, v)
 
         results = [_to_host_value(v, return_numpy) for v in fetches]
+        if CHECK_WHILE_BOUND:
+            for n, v in zip(fetch_names[n_user_fetches:],
+                            results[n_user_fetches:]):
+                if bool(np.asarray(v).reshape(-1)[0]):
+                    raise RuntimeError(
+                        f"bounded While loop flag {n!r}: the loop hit "
+                        "max_steps with its condition still true — it "
+                        "was truncated; raise max_steps")
+            results = results[:n_user_fetches]
         if CHECK_NAN_INF:
             for n, v in zip(fetch_names, results):
                 arr = v.data if isinstance(v, LoDTensor) else v

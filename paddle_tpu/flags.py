@@ -39,6 +39,12 @@ FLAGS: Dict[str, tuple] = {
         "1", "ops/sequence_ops.py",
         "fused Pallas GRU kernel on TPU (~1.8x over scan on v5e; same "
         "force/0/1 semantics)"),
+    "PADDLE_TPU_CHECK_WHILE_BOUND": (
+        "0", "core/executor.py",
+        "raise when a top-level bounded While (max_steps=N) truncated a "
+        "loop whose condition was still true (per-run host readback; "
+        "the `<name>.exhausted` bool var is always available to fetch; "
+        "loops nested in sub-blocks keep their flag block-local)"),
     "PADDLE_TPU_DATA_HOME": (
         "~/.cache/paddle_tpu/dataset", "dataset/common.py",
         "dataset download/cache directory"),

@@ -387,9 +387,19 @@ class While:
                 if parent.desc.find_var_recursive(n) is not None \
                         and n not in written:
                     written.append(n)
+        outputs = {"Out": written}
+        self.exhausted = None
+        if self.max_steps:
+            # True iff the condition was still true after max_steps —
+            # fetch it (or set PADDLE_TPU_CHECK_WHILE_BOUND=1) to catch
+            # silent truncation of the bounded lowering
+            self.exhausted = self.helper.create_variable(
+                name=f"{self.helper.name}.exhausted", dtype="bool",
+                shape=[], stop_gradient=True)
+            outputs["Exhausted"] = [self.exhausted.name]
         self.helper.append_op(
             type="while", inputs={"Cond": self.cond_var},
-            outputs={"Out": written},
+            outputs=outputs,
             attrs={"sub_block_idx": blk.idx,
                    "carried_names": written,
                    "cond_name": self.cond_var.name,

@@ -90,9 +90,13 @@ def _while(ctx):
             return (active & new_cond, kept), None
 
         state0 = (cond0.reshape(()).astype(jnp.bool_), init)
-        (_, final_vals), _ = jax.lax.scan(scan_body, state0, None,
-                                          length=max_steps)
+        (still_active, final_vals), _ = jax.lax.scan(
+            scan_body, state0, None, length=max_steps)
         ctx.set_outputs("Out", list(final_vals))
+        # still true after max_steps iterations => the loop was truncated
+        # (silent-truncation hazard of the bounded lowering); surfaced as
+        # an optional output the layer wires to `<name>.exhausted`
+        ctx.set_output("Exhausted", still_active)
         return
 
     def cond_fn(state):

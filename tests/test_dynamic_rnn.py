@@ -217,3 +217,81 @@ def test_if_else_trains_through_both_branches():
         (lv,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
         losses.append(float(lv))
     assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])
+
+
+def test_bounded_while_exhaustion_flag():
+    """While(max_steps=N): the `<name>.exhausted` bool var reports silent
+    truncation; PADDLE_TPU_CHECK_WHILE_BOUND=1 turns it into an error."""
+    import pytest
+
+    def build(max_steps):
+        pt.reset_default_programs()
+        pt.reset_global_scope()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            i = layers.fill_constant([1], "float32", 0.0)
+            limit = layers.fill_constant([1], "float32", 5.0)
+            cond = cf.less_than_v(i, limit)
+            w = cf.While(cond, max_steps=max_steps)
+            with w.block():
+                layers.increment(i, value=1.0, in_place=True)
+                cf.less_than_v(i, limit, cond=cond)
+        return main, startup, i, w
+
+    # bound comfortably above the trip count (5): not exhausted
+    main, startup, i, w = build(max_steps=8)
+    exe = pt.Executor()
+    exe.run(startup)
+    iv, ex = exe.run(main, fetch_list=[i, w.exhausted])
+    assert float(np.asarray(iv)) == 5.0
+    assert not bool(np.asarray(ex))
+
+    # bound below the trip count: truncated, flag set
+    main, startup, i, w = build(max_steps=3)
+    exe = pt.Executor()
+    exe.run(startup)
+    iv, ex = exe.run(main, fetch_list=[i, w.exhausted])
+    assert float(np.asarray(iv)) == 3.0
+    assert bool(np.asarray(ex))
+
+    # executor-enforced mode
+    from paddle_tpu.core import executor as exmod
+    old = exmod.CHECK_WHILE_BOUND
+    exmod.CHECK_WHILE_BOUND = True
+    try:
+        main, startup, i, w = build(max_steps=3)
+        exe = pt.Executor()
+        exe.run(startup)
+        with pytest.raises(RuntimeError, match="max_steps"):
+            exe.run(main, fetch_list=[i])
+    finally:
+        exmod.CHECK_WHILE_BOUND = old
+
+
+def test_bounded_while_check_fires_even_when_user_fetches_flag():
+    import pytest
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.layers import control_flow as cf
+    from paddle_tpu.core import executor as exmod
+
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        i = layers.fill_constant([1], "float32", 0.0)
+        limit = layers.fill_constant([1], "float32", 5.0)
+        cond = cf.less_than_v(i, limit)
+        w = cf.While(cond, max_steps=3)
+        with w.block():
+            layers.increment(i, value=1.0, in_place=True)
+            cf.less_than_v(i, limit, cond=cond)
+    old = exmod.CHECK_WHILE_BOUND
+    exmod.CHECK_WHILE_BOUND = True
+    try:
+        exe = pt.Executor()
+        exe.run(startup)
+        with pytest.raises(RuntimeError, match="max_steps"):
+            exe.run(main, fetch_list=[i, w.exhausted])
+    finally:
+        exmod.CHECK_WHILE_BOUND = old
