@@ -4,10 +4,12 @@ Each module exposes creator functions returning readers (zero-arg callables
 yielding samples) with the reference's sample schemas; data is synthetic
 when the real corpus is not cached locally (see common.py).
 """
-from . import (cifar, common, conll05, flowers, imdb, imikolov, mnist,
-               movielens, sentiment, uci_housing, voc2012, wmt14, wmt16)
+from . import (cifar, common, conll05, flowers, image, imdb, imikolov,
+               mnist, movielens, mq2007, sentiment, uci_housing, voc2012,
+               wmt14, wmt16)
 
 __all__ = [
-    "cifar", "common", "conll05", "flowers", "imdb", "imikolov", "mnist",
-    "movielens", "sentiment", "uci_housing", "voc2012", "wmt14", "wmt16",
+    "cifar", "common", "conll05", "flowers", "image", "imdb", "imikolov",
+    "mnist", "movielens", "mq2007", "sentiment", "uci_housing", "voc2012",
+    "wmt14", "wmt16",
 ]

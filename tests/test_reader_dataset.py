@@ -98,3 +98,116 @@ def test_movielens_schema():
     assert len(s) == 8
     assert isinstance(s[5], list) and isinstance(s[6], list)
     assert 1 <= s[7] <= 5
+
+
+def test_mq2007_formats_and_schema():
+    from paddle_tpu.dataset import mq2007
+    # pointwise: (rel, 46-dim features)
+    rel, feat = next(mq2007.train(format="pointwise")())
+    assert feat.shape == (mq2007.FEATURE_DIM,) and 0 <= int(rel) <= 2
+    # pairwise: (label, hi, lo) with hi ranked above lo
+    lbl, hi, lo = next(mq2007.train(format="pairwise")())
+    assert lbl.shape == (1,) and hi.shape == lo.shape == (46,)
+    # listwise: per-query matrices
+    rels, feats = next(mq2007.train(format="listwise")())
+    assert feats.shape == (len(rels), 46)
+    # plain_txt: (query_id, relevance, features)
+    qid, rel2, feat2 = next(mq2007.train(format="plain_txt")())
+    assert isinstance(qid, int) and feat2.shape == (46,)
+    # determinism
+    a = list(mq2007.test(format="pointwise")())[:5]
+    b = list(mq2007.test(format="pointwise")())[:5]
+    for (ra, fa), (rb, fb) in zip(a, b):
+        assert ra == rb
+        np.testing.assert_allclose(fa, fb)
+    with pytest.raises(ValueError):
+        mq2007.train(format="bogus")
+
+
+def test_mq2007_pairwise_ranknet_learns():
+    """The synthetic corpus must be learnable: a linear RankNet trained on
+    pairwise data should order held-out pairs correctly."""
+    from paddle_tpu.dataset import mq2007
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        hi = layers.data("hi", [46], dtype="float32")
+        lo = layers.data("lo", [46], dtype="float32")
+        w = pt.ParamAttr(name="rank_w")
+        s_hi = layers.fc(hi, size=1, param_attr=w, bias_attr=False)
+        s_lo = layers.fc(lo, size=1, param_attr=w, bias_attr=False)
+        # RankNet loss: -log sigmoid(s_hi - s_lo)
+        diff = layers.elementwise_sub(s_hi, s_lo)
+        loss = layers.mean(layers.softplus(layers.scale(diff, scale=-1.0)))
+        pt.optimizer.AdamOptimizer(learning_rate=0.05).minimize(loss)
+
+    pairs = list(mq2007.train(format="pairwise")())
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    for _ in range(60):
+        idx = rng.randint(0, len(pairs), 64)
+        his = np.stack([pairs[i][1] for i in idx])
+        los = np.stack([pairs[i][2] for i in idx])
+        exe.run(main, feed={"hi": his, "lo": los}, fetch_list=[loss])
+
+    test_pairs = list(mq2007.test(format="pairwise")())
+    his = np.stack([p[1] for p in test_pairs])
+    los = np.stack([p[2] for p in test_pairs])
+    (sh, sl) = exe.run(main, feed={"hi": his, "lo": los},
+                       fetch_list=[s_hi, s_lo])
+    acc = float(np.mean(np.asarray(sh) > np.asarray(sl)))
+    assert acc > 0.8, acc
+
+
+def test_image_utils_roundtrip(tmp_path):
+    from paddle_tpu.dataset import image as img
+
+    rng = np.random.RandomState(0)
+    im = rng.randint(0, 255, (60, 80, 3)).astype(np.uint8)
+
+    r = img.resize_short(im, 30)           # short edge (h) -> 30
+    assert r.shape[0] == 30 and r.shape[1] == 40
+    c = img.center_crop(r, 24)
+    assert c.shape[:2] == (24, 24)
+    rc = img.random_crop(r, 24, rng=np.random.RandomState(1))
+    assert rc.shape[:2] == (24, 24)
+    f = img.left_right_flip(c)
+    np.testing.assert_array_equal(f[:, ::-1], c)
+    chw = img.to_chw(c)
+    assert chw.shape == (3, 24, 24)
+
+    out = img.simple_transform(im, 32, 24, is_train=False,
+                               mean=[1.0, 2.0, 3.0])
+    assert out.shape == (3, 24, 24) and out.dtype == np.float32
+
+    # encode/decode via PIL bytes
+    from PIL import Image
+    buf_path = tmp_path / "x.png"
+    Image.fromarray(im).save(buf_path)
+    back = img.load_image(str(buf_path))
+    np.testing.assert_array_equal(back, im)
+    data = open(buf_path, "rb").read()
+    np.testing.assert_array_equal(img.load_image_bytes(data), im)
+    gray = img.load_image(str(buf_path), is_color=False)
+    assert gray.ndim == 2
+
+    # batch_images_from_tar
+    import tarfile
+    tar_path = str(tmp_path / "imgs.tar")
+    with tarfile.open(tar_path, "w") as tf:
+        for i in range(3):
+            p = tmp_path / f"im{i}.png"
+            Image.fromarray(im).save(p)
+            tf.add(str(p), arcname=f"im{i}.png")
+    meta = img.batch_images_from_tar(
+        tar_path, "trial", {f"im{i}.png": i for i in range(3)},
+        num_per_batch=2)
+    files = open(meta).read().split()
+    assert len(files) == 2  # 3 images, 2 per batch
+    loaded = np.load(files[0], allow_pickle=True)
+    assert list(loaded["labels"]) == [0, 1]
+    np.testing.assert_array_equal(
+        img.load_image_bytes(loaded["data"][0]), im)
