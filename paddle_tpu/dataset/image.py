@@ -96,6 +96,10 @@ def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,
     if mean is not None:
         mean = np.asarray(mean, np.float32)
         if mean.ndim == 1:  # per-channel
+            if mean.shape[0] != im.shape[0]:
+                raise ValueError(
+                    f"per-channel mean has {mean.shape[0]} entries but "
+                    f"the image has {im.shape[0]} channel(s)")
             mean = mean[:, None, None]
         im -= mean
     return im

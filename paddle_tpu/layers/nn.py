@@ -39,6 +39,8 @@ __all__ = [
     "multiplex", "lstm_unit", "gru_unit", "dynamic_lstmp",
     "ctc_greedy_decoder", "chunk_eval", "autoincreased_step_counter",
     "lod_reset", "prelu", "label_smooth", "rank_loss", "roi_pool",
+    "bilinear_interp", "nearest_interp", "resize_bilinear", "upsample",
+    "sampling_id",
 ]
 
 
@@ -1257,3 +1259,45 @@ def roi_pool(input, rois, pooled_height=1, pooled_width=1,
                             "pooled_width": pooled_width,
                             "spatial_scale": spatial_scale})
     return out
+
+
+def _interp_layer(op_type, input, out_shape=None, scale=None, name=None):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    attrs = {}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), \
+            int(out_shape[1])
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op(type=op_type, inputs={"X": input},
+                     outputs={"Out": out}, attrs=attrs)
+    return out
+
+
+def bilinear_interp(input, out_shape=None, scale=None, name=None):
+    """Bilinear NCHW resize (reference: legacy bilinear_interp layer)."""
+    return _interp_layer("bilinear_interp", input, out_shape, scale, name)
+
+
+def nearest_interp(input, out_shape=None, scale=None, name=None):
+    """Nearest-neighbor NCHW resize (reference: legacy upsample/resize)."""
+    return _interp_layer("nearest_interp", input, out_shape, scale, name)
+
+
+resize_bilinear = bilinear_interp
+
+
+def upsample(input, scale=2, name=None):
+    return _interp_layer("nearest_interp", input, None, scale, name)
+
+
+def sampling_id(x, seed=0, name=None):
+    """Sample one id per row from probabilities (reference: sampling_id
+    layer; stochastic generation)."""
+    helper = LayerHelper("sampling_id", name=name)
+    out = helper.create_tmp_variable("int64")
+    helper.append_op(type="sampling_id", inputs={"X": x},
+                     outputs={"Out": out}, attrs={"seed": seed})
+    return out
+

@@ -778,3 +778,43 @@ def _conv3d_transpose(ctx):
     p = _triple(ctx.attr("paddings", [0, 0, 0]))
     d = _triple(ctx.attr("dilations", [1, 1, 1]))
     ctx.set_output("Output", _conv_transpose_impl(x, w, s, p, d, 3))
+
+
+def _interp_impl(ctx, method: str):
+    """NCHW resize (reference capability: legacy gserver bilinear_interp /
+    upsample / resize layers; later-fluid bilinear_interp_op). out size
+    from out_h/out_w attrs or a scale factor."""
+    x = ctx.input("X")
+    n, c, h, w = x.shape
+    out_h = int(ctx.attr("out_h", 0) or 0)
+    out_w = int(ctx.attr("out_w", 0) or 0)
+    scale = float(ctx.attr("scale", 0.0) or 0.0)
+    if out_h <= 0 or out_w <= 0:
+        if scale <= 0:
+            raise ValueError(
+                f"{ctx.op.type} needs positive out_h/out_w attrs or a "
+                "positive scale attr")
+        out_h, out_w = int(h * scale), int(w * scale)
+    out = jax.image.resize(x, (n, c, out_h, out_w), method=method)
+    ctx.set_output("Out", out.astype(x.dtype))
+
+
+@register_op("bilinear_interp")
+def _bilinear_interp(ctx):
+    _interp_impl(ctx, "bilinear")
+
+
+@register_op("nearest_interp")
+def _nearest_interp(ctx):
+    _interp_impl(ctx, "nearest")
+
+
+@register_op("sampling_id", no_grad_slots=["X"])
+def _sampling_id(ctx):
+    """Sample one class id per row from a probability matrix (reference:
+    legacy sampling_id layer; generation-time stochastic decode)."""
+    x = ctx.input("X")  # [batch, n_classes] probabilities
+    logits = jnp.log(jnp.maximum(x, 1e-20))
+    ids = jax.random.categorical(_op_key(ctx), logits, axis=-1)
+    ctx.set_output("Out", ids.astype(jnp.int64))
+

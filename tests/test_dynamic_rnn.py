@@ -243,16 +243,16 @@ def test_bounded_while_exhaustion_flag():
     exe = pt.Executor()
     exe.run(startup)
     iv, ex = exe.run(main, fetch_list=[i, w.exhausted])
-    assert float(np.asarray(iv).reshape(-1)[0]) == 5.0
-    assert not bool(np.asarray(ex).reshape(-1)[0])
+    assert np.asarray(iv).item() == 5.0
+    assert not np.asarray(ex).item()
 
     # bound below the trip count: truncated, flag set
     main, startup, i, w = build(max_steps=3)
     exe = pt.Executor()
     exe.run(startup)
     iv, ex = exe.run(main, fetch_list=[i, w.exhausted])
-    assert float(np.asarray(iv).reshape(-1)[0]) == 3.0
-    assert bool(np.asarray(ex).reshape(-1)[0])
+    assert np.asarray(iv).item() == 3.0
+    assert np.asarray(ex).item()
 
     # executor-enforced mode
     from paddle_tpu.core import executor as exmod

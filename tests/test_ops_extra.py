@@ -606,3 +606,51 @@ def test_nce_trains_word_embeddings():
                         fetch_list=[loss])
         losses.append(float(lv))
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_interp_ops_match_numpy():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 4, 6).astype(np.float32)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        xin = layers.data("x", [3, 4, 6], dtype="float32")
+        up_n = layers.nearest_interp(xin, out_shape=(8, 12))
+        up_b = layers.bilinear_interp(xin, scale=2.0)
+        down = layers.resize_bilinear(xin, out_shape=(2, 3))
+        u2 = layers.upsample(xin, scale=2)
+    exe = pt.Executor()
+    exe.run(startup)
+    n_v, b_v, d_v, u_v = exe.run(main, feed={"x": x},
+                                 fetch_list=[up_n, up_b, down, u2])
+    assert np.asarray(n_v).shape == (2, 3, 8, 12)
+    assert np.asarray(b_v).shape == (2, 3, 8, 12)
+    assert np.asarray(d_v).shape == (2, 3, 2, 3)
+    # nearest 2x upsample == numpy repeat
+    np.testing.assert_allclose(np.asarray(u_v),
+                               x.repeat(2, axis=2).repeat(2, axis=3),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(n_v), np.asarray(u_v), rtol=1e-6)
+
+
+def test_argmax_and_sampling_id():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    rng = np.random.RandomState(1)
+    probs = np.zeros((6, 5), np.float32)
+    hot = rng.randint(0, 5, 6)
+    probs[np.arange(6), hot] = 1.0  # deterministic distributions
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        p = layers.data("p", [5], dtype="float32")
+        am = layers.argmax(p, axis=-1)
+        sid = layers.sampling_id(p)
+    exe = pt.Executor()
+    exe.run(startup)
+    am_v, sid_v = exe.run(main, feed={"p": probs}, fetch_list=[am, sid])
+    np.testing.assert_array_equal(np.asarray(am_v), hot)
+    # with one-hot probs, sampling must return the hot index
+    np.testing.assert_array_equal(np.asarray(sid_v), hot)

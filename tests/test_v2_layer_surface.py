@@ -1,0 +1,141 @@
+"""Legacy-v2 layer-type parity audit: the 103 layer types the reference
+registers via REGISTER_LAYER (paddle/gserver/layers/*.cpp, extracted at
+survey time) each map to a capability here — a same-capability op, a
+layers/ function, a documented composition, or a subsuming mechanism
+(PARITY.md N21-N24 row: one op library serves both stacks). The mapping
+is enforced: every op/layer named as a target must actually exist.
+"""
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu  # noqa: F401
+from paddle_tpu import layers
+from paddle_tpu.core.registry import OpRegistry
+
+# layer type -> (kind, target). kind: "op" (registered op name),
+# "layer" (paddle_tpu.layers attr), "compose" (documented composition),
+# "subsumed" (framework mechanism replaces it).
+V2_LAYERS = {
+    "addto": ("op", "sum"),
+    "agent": ("subsumed", "DynamicRNN step closures"),
+    "average": ("op", "sequence_pool"),
+    "batch_norm": ("op", "batch_norm"),
+    "bilinear_interp": ("op", "bilinear_interp"),
+    "blockexpand": ("op", "im2sequence"),
+    "clip": ("op", "clip"),
+    "concat": ("op", "concat"),
+    "concat2": ("op", "concat"),
+    "conv3d": ("op", "conv3d"),
+    "conv_shift": ("op", "conv_shift"),
+    "convex_comb": ("compose", "scale + elementwise_add interpolation"),
+    "cos": ("op", "cos_sim"),
+    "cos_vm": ("op", "cos_sim"),
+    "crf": ("op", "linear_chain_crf"),
+    "crf_decoding": ("op", "crf_decoding"),
+    "crop": ("op", "crop"),
+    "cross_entropy_over_beam": (
+        "compose", "beam_search + softmax_with_cross_entropy"),
+    "ctc": ("op", "warpctc"),
+    "cudnn_batch_norm": ("op", "batch_norm"),
+    "cudnn_conv": ("op", "conv2d"),
+    "cudnn_convt": ("op", "conv2d_transpose"),
+    "data": ("layer", "data"),
+    "data_norm": ("compose", "batch_norm / scale with frozen stats"),
+    "deconv3d": ("op", "conv3d_transpose"),
+    "detection_output": ("layer", "detection_output"),
+    "dot_prod": ("op", "dot"),
+    "eos_id": ("subsumed", "beam_search end_id handling"),
+    "exconv": ("op", "conv2d"),
+    "exconvt": ("op", "conv2d_transpose"),
+    "expand": ("op", "expand"),
+    "factorization_machine": ("subsumed", "models/deepfm.py FM term"),
+    "fc": ("layer", "fc"),
+    "featmap_expand": ("op", "expand"),
+    "gated_recurrent": ("op", "gru"),
+    "gather_agent": ("subsumed", "DynamicRNN step closures"),
+    "get_output": ("subsumed", "multi-output fetch by var name"),
+    "gru_step": ("op", "gru_unit"),
+    "hsigmoid": ("layer", "hsigmoid"),
+    "huber_classification": ("op", "huber_loss"),
+    "huber_regression": ("op", "smooth_l1_loss"),
+    "interpolation": ("compose", "scale + elementwise_add"),
+    "kmax_seq_score": ("op", "top_k"),
+    "l2_distance": ("compose", "elementwise_sub + square + reduce_sum"),
+    "lambda_cost": ("compose", "rank_loss / margin_rank_loss family"),
+    "lstm_step": ("op", "lstm_unit"),
+    "lstmemory": ("op", "lstm"),
+    "max": ("op", "sequence_pool"),
+    "maxid": ("op", "arg_max"),
+    "maxout": ("op", "maxout"),
+    "mdlstmemory": ("compose", "nested lax.scan over 2 axes"),
+    "mixed": ("layer", "fc"),  # multi-input projections summed
+    "mkl_packed_recurrent": ("op", "static_rnn"),
+    "mkldnn_addto": ("op", "sum"),
+    "mkldnn_batch_norm": ("op", "batch_norm"),
+    "mkldnn_concat": ("op", "concat"),
+    "mkldnn_conv": ("op", "conv2d"),
+    "mkldnn_fc": ("layer", "fc"),
+    "mkldnn_lrn": ("op", "lrn"),
+    "mkldnn_pool": ("op", "pool2d"),
+    "multi_binary_label_cross_entropy": (
+        "op", "sigmoid_cross_entropy_with_logits"),
+    "multi_class_cross_entropy_with_selfnorm": (
+        "compose", "softmax_with_cross_entropy + norm penalty"),
+    "multibox_loss": ("layer", "ssd_loss"),
+    "multiplex": ("op", "multiplex"),
+    "nce": ("op", "nce"),
+    "out_prod": ("compose", "matmul outer product"),
+    "pad": ("op", "pad"),
+    "pool3d": ("op", "pool3d"),
+    "power": ("op", "pow"),
+    "prelu": ("op", "prelu"),
+    "print": ("op", "print"),
+    "priorbox": ("op", "prior_box"),
+    "recurrent": ("op", "static_rnn"),
+    "recurrent_layer_group": ("subsumed", "DynamicRNN masked scan"),
+    "resize": ("op", "nearest_interp"),
+    "roi_pool": ("op", "roi_pool"),
+    "rotate": ("op", "transpose"),
+    "row_conv": ("op", "row_conv"),
+    "row_l2_norm": ("op", "l2_normalize"),
+    "sampling_id": ("op", "sampling_id"),
+    "scale_shift": ("op", "scale"),  # scale attr + bias attr
+    "scale_sub_region": ("compose", "crop + scale + paste via where"),
+    "scaling": ("op", "elementwise_mul"),
+    "scatter_agent": ("subsumed", "DynamicRNN step closures"),
+    "selective_fc": ("compose", "fc + multiplex/mask"),
+    "seq_slice": ("op", "sequence_slice"),
+    "seqconcat": ("op", "sequence_concat"),
+    "seqlastins": ("op", "sequence_last_step"),
+    "seqreshape": ("op", "sequence_reshape"),
+    "slope_intercept": ("op", "scale"),
+    "smooth_l1": ("op", "smooth_l1_loss"),
+    "soft_binary_class_cross_entropy": (
+        "op", "sigmoid_cross_entropy_with_logits"),
+    "spp": ("op", "spp"),
+    "square_error": ("op", "square_error_cost"),
+    "sub_nested_seq": ("op", "nested_sequence_flatten"),
+    "subseq": ("op", "sequence_slice"),
+    "sum_cost": ("op", "reduce_sum"),
+    "sum_to_one_norm": ("compose", "x / reduce_sum(x) elementwise"),
+    "switch_order": ("op", "transpose"),
+    "tensor": ("op", "bilinear_tensor_product"),
+    "trans": ("op", "transpose"),
+    "upsample": ("layer", "upsample"),
+    "warp_ctc": ("op", "warpctc"),
+}
+
+
+def test_all_103_v2_layer_types_mapped():
+    assert len(V2_LAYERS) == 103, len(V2_LAYERS)
+
+
+def test_v2_layer_targets_exist():
+    missing = []
+    for name, (kind, target) in V2_LAYERS.items():
+        if kind == "op" and not OpRegistry.has(target):
+            missing.append((name, "op", target))
+        elif kind == "layer" and not hasattr(layers, target):
+            missing.append((name, "layer", target))
+    assert not missing, missing
