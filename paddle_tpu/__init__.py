@@ -6,7 +6,7 @@ sharding over a device mesh instead of NCCL/parameter servers.
 """
 
 from .core.scope import Scope, global_scope, reset_global_scope  # noqa
-from .core.lod import LoDTensor, RaggedPair  # noqa
+from .core.lod import LoDTensor, RaggedNested, RaggedPair  # noqa
 from .core.backward import append_backward, calc_gradient  # noqa
 from . import ops  # noqa  (registers all op types)
 from .framework import (  # noqa
@@ -26,6 +26,7 @@ from . import io  # noqa
 from . import metrics  # noqa
 from . import profiler  # noqa
 from . import flags  # noqa
+from . import debug  # noqa
 from .parallel import ParallelExecutor  # noqa
 from . import reader  # noqa
 from .reader import batch  # noqa

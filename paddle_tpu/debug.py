@@ -1,0 +1,130 @@
+"""Debug / visualization utilities.
+
+Reference parity: python/paddle/fluid/debuger.py (program printer),
+python/paddle/fluid/graphviz.py + net_drawer.py (Graphviz export of the
+op graph), and python/paddle/v2/plot/plot.py (Ploter training-curve
+helper). The DOT emitter writes plain Graphviz source — no graphviz
+binary required to produce it.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["program_to_code", "draw_graph", "Ploter"]
+
+
+def program_to_code(program) -> str:
+    """Readable listing of every block's vars and ops (reference:
+    debuger.py pprint_program_codes)."""
+    desc = program.desc if hasattr(program, "desc") else program
+    lines = []
+    for bi, block in enumerate(desc.blocks):
+        lines.append(f"// block {bi} (parent {block.parent_idx})")
+        for name, v in sorted(block.vars.items()):
+            kind = "param" if getattr(v, "is_parameter", False) else "var"
+            lines.append(f"  {kind} {name}: shape={v.shape} "
+                         f"dtype={v.dtype} lod={v.lod_level}")
+        for op in block.ops:
+            ins = ", ".join(f"{slot}=[{', '.join(ns)}]"
+                            for slot, ns in sorted(op.inputs.items()))
+            outs = ", ".join(f"{slot}=[{', '.join(ns)}]"
+                             for slot, ns in sorted(op.outputs.items()))
+            attrs = {k: v for k, v in op.attrs.items()
+                     if not k.startswith("__")}
+            lines.append(f"  {{{outs}}} = {op.type}({ins}) attrs={attrs}")
+    return "\n".join(lines)
+
+
+def _dot_escape(s: str) -> str:
+    return s.replace('"', '\\"')
+
+
+def draw_graph(program, path: Optional[str] = None,
+               block_idx: int = 0) -> str:
+    """Emit Graphviz DOT for one block's op/var graph (reference:
+    net_drawer.py draw_graph / graphviz.py). Ops are boxes, variables are
+    ellipses (parameters shaded); edges follow dataflow. Returns the DOT
+    source; writes it to `path` when given."""
+    desc = program.desc if hasattr(program, "desc") else program
+    block = desc.blocks[block_idx]
+    out = ["digraph G {", "  rankdir=TB;"]
+    seen_vars = set()
+
+    def var_node(name):
+        if name in seen_vars:
+            return
+        seen_vars.add(name)
+        v = block.find_var_recursive(name)
+        shape = getattr(v, "shape", None) if v is not None else None
+        style = ""
+        if v is not None and getattr(v, "is_parameter", False):
+            style = ' style=filled fillcolor="lightblue"'
+        label = _dot_escape(f"{name}\\n{shape}" if shape else name)
+        out.append(f'  "v_{_dot_escape(name)}" [label="{label}" '
+                   f'shape=ellipse{style}];')
+
+    for i, op in enumerate(block.ops):
+        out.append(f'  "op_{i}" [label="{_dot_escape(op.type)}" '
+                   'shape=box style=filled fillcolor="lightgray"];')
+        for names in op.inputs.values():
+            for n in names:
+                var_node(n)
+                out.append(f'  "v_{_dot_escape(n)}" -> "op_{i}";')
+        for names in op.outputs.values():
+            for n in names:
+                var_node(n)
+                out.append(f'  "op_{i}" -> "v_{_dot_escape(n)}";')
+    out.append("}")
+    dot = "\n".join(out)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot
+
+
+class Ploter:
+    """Training-curve helper (reference: v2/plot/plot.py Ploter). Collects
+    (step, value) per named series; `plot()` renders via matplotlib when a
+    display backend is usable and always keeps the raw data accessible."""
+
+    def __init__(self, *titles: str):
+        if not titles:
+            raise ValueError("Ploter needs at least one series title")
+        self.titles = list(titles)
+        self.data = {t: ([], []) for t in titles}
+
+    def append(self, title: str, step: int, value: float):
+        if title not in self.data:
+            raise KeyError(f"unknown series {title!r}; declared: "
+                           f"{self.titles}")
+        xs, ys = self.data[title]
+        xs.append(int(step))
+        ys.append(float(value))
+
+    def reset(self):
+        for t in self.titles:
+            self.data[t] = ([], [])
+
+    def series(self, title: str):
+        xs, ys = self.data[title]
+        return list(xs), list(ys)
+
+    def plot(self, path: Optional[str] = None):
+        """Render all series into one figure; saves to `path` if given
+        (Agg backend — works headless), else shows interactively."""
+        import matplotlib
+        if path:
+            matplotlib.use("Agg", force=False)
+        import matplotlib.pyplot as plt
+        fig, ax = plt.subplots()
+        for t in self.titles:
+            xs, ys = self.data[t]
+            ax.plot(xs, ys, label=t)
+        ax.set_xlabel("step")
+        ax.legend()
+        if path:
+            fig.savefig(path)
+            plt.close(fig)
+        else:  # pragma: no cover - interactive
+            plt.show()
+        return fig

@@ -1,0 +1,100 @@
+"""Distributed book test via the reference's env-role contract: the SAME
+training function runs as PSERVER or TRAINER based on TRAINING_ROLE
+(reference: tests/book/test_fit_a_line.py:71-95 — multi-node exercised on
+one machine by launching multiple processes with TRAINING_ROLE /
+PADDLE_INIT_* envs). The transport here is the async parameter service
+(distributed/pserver.py) instead of the reference's gRPC pserver."""
+import multiprocessing as mp
+import os
+
+import numpy as np
+
+_W = np.linspace(-1.0, 1.0, 13).astype(np.float32)  # uci_housing's truth
+
+
+def _run_role(role, endpoint, trainer_id, ctrl_q, result_q):
+    """One process of the cluster; role comes from TRAINING_ROLE just as
+    in the reference book scripts."""
+    os.environ["TRAINING_ROLE"] = role
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.distributed import (AsyncParameterServer,
+                                        PServerClient, PServerServer)
+
+    if role == "PSERVER":
+        ps = AsyncParameterServer(optimizer="sgd", lr=0.1)
+        server = PServerServer(ps, port=0)
+        server.start()
+        result_q.put(server.endpoint)
+        msg = ctrl_q.get()          # blocks until the launcher says stop
+        assert msg == "stop"
+        result_q.put(ps.get_param("fit_w"))
+        server.shutdown()
+        return
+
+    # TRAINER: build the fit_a_line program, pull params, push grads
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.core.backward import append_backward
+    from paddle_tpu.core.scope import global_scope
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [13])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1, bias_attr=False,
+                         param_attr=pt.ParamAttr(name="fit_w"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pairs = append_backward(loss)
+    gname = dict((p if isinstance(p, str) else p.name, g)
+                 for p, g in pairs)["fit_w"]
+
+    c = PServerClient(endpoint)
+    if trainer_id == 0:
+        c.init_param("fit_w", np.zeros((13, 1), np.float32))
+        c.finish_init()
+    assert c.wait_init(20.0)
+
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(trainer_id)
+    for _ in range(80):
+        xs = rng.randn(32, 13).astype(np.float32)
+        ys = (xs @ _W).reshape(-1, 1) + \
+            0.01 * rng.randn(32, 1).astype(np.float32)
+        global_scope().set("fit_w", c.get_param("fit_w"))
+        (g,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[gname])
+        c.push_grad("fit_w", np.asarray(g))
+    c.close()
+
+
+def test_fit_a_line_distributed_roles():
+    ctx = mp.get_context("spawn")
+    ctrl_q = ctx.Queue()     # launcher -> pserver ("stop")
+    result_q = ctx.Queue()   # pserver -> launcher (endpoint, weights)
+    psp = ctx.Process(target=_run_role,
+                      args=("PSERVER", None, -1, ctrl_q, result_q))
+    trainers = []
+    try:
+        psp.start()
+        endpoint = result_q.get(timeout=120)
+
+        trainers = [
+            ctx.Process(target=_run_role,
+                        args=("TRAINER", endpoint, tid, ctrl_q, result_q))
+            for tid in range(2)]
+        for t in trainers:
+            t.start()
+        for t in trainers:
+            t.join(timeout=240)
+            assert t.exitcode == 0, t.exitcode
+
+        ctrl_q.put("stop")
+        w = result_q.get(timeout=60)
+        psp.join(timeout=60)
+        np.testing.assert_allclose(np.ravel(w), _W, atol=0.05)
+    finally:
+        for p in [psp] + trainers:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=10)

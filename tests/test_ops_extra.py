@@ -654,3 +654,44 @@ def test_argmax_and_sampling_id():
     np.testing.assert_array_equal(np.asarray(am_v), hot)
     # with one-hot probs, sampling must return the hot index
     np.testing.assert_array_equal(np.asarray(sid_v), hot)
+
+
+def test_debug_viz_utilities(tmp_path):
+    """program_to_code / draw_graph / Ploter (reference: debuger.py,
+    net_drawer.py, v2 plot utils)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.debug import Ploter, draw_graph, program_to_code
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        y = layers.fc(x, size=2, act="relu")
+        loss = layers.mean(y)
+        pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+
+    code = program_to_code(main)
+    assert "mul(" in code and "param " in code and "relu" in code
+
+    dot_path = tmp_path / "g.dot"
+    dot = draw_graph(main, str(dot_path))
+    assert dot.startswith("digraph") and dot.rstrip().endswith("}")
+    assert '"op_0"' in dot and "lightblue" in dot  # params shaded
+    assert dot_path.read_text() == dot
+    # every op got a node
+    n_ops = len(main.desc.blocks[0].ops)
+    assert all(f'"op_{i}"' in dot for i in range(n_ops))
+
+    pl = Ploter("train", "test")
+    for s in range(5):
+        pl.append("train", s, 1.0 / (s + 1))
+    pl.append("test", 0, 0.5)
+    xs, ys = pl.series("train")
+    assert xs == list(range(5)) and ys[0] == 1.0
+    png = tmp_path / "curve.png"
+    pl.plot(str(png))
+    assert png.stat().st_size > 0
+    with pytest.raises(KeyError):
+        pl.append("bogus", 0, 1.0)
+    pl.reset()
+    assert pl.series("train") == ([], [])
