@@ -5,7 +5,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["Accuracy", "EditDistance", "CompositeMetric", "Auc"]
+__all__ = ["Accuracy", "ChunkEvaluator", "EditDistance",
+           "CompositeMetric", "Auc"]
 
 
 class MetricBase:
@@ -39,6 +40,38 @@ class Accuracy(MetricBase):
         if self.weight == 0:
             raise ValueError("no batches accumulated")
         return self.value / self.weight
+
+
+class ChunkEvaluator(MetricBase):
+    """Streaming chunking P/R/F1 (reference: evaluator.py ChunkEvaluator):
+    accumulate the per-batch chunk counts the chunk_eval op emits
+    (NumInferChunks / NumLabelChunks / NumCorrectChunks) and report the
+    corpus-level precision, recall, F1."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).item())
+        self.num_label_chunks += int(np.asarray(num_label_chunks).item())
+        self.num_correct_chunks += \
+            int(np.asarray(num_correct_chunks).item())
+
+    def eval(self):
+        precision = self.num_correct_chunks / self.num_infer_chunks \
+            if self.num_infer_chunks else 0.0
+        recall = self.num_correct_chunks / self.num_label_chunks \
+            if self.num_label_chunks else 0.0
+        f1 = 2 * precision * recall / (precision + recall) \
+            if self.num_correct_chunks else 0.0
+        return precision, recall, f1
 
 
 class EditDistance(MetricBase):

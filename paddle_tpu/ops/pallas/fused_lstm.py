@@ -66,7 +66,11 @@ def _fwd_kernel(len_ref, x_ref, w_ref, b_ref, h0_ref, c0_ref,
 def _bwd_kernel(len_ref, x_ref, w_ref, b_ref, h0_ref, c0_ref,
                 h_all_ref, c_all_ref, dh_out_ref, dc_out_ref,
                 dx_ref, dw_ref, db_ref, dh0_ref, dc0_ref,
-                dh_scr, dc_scr, dw_scr, db_scr, *, hidden, t_max):
+                dh_scr, dc_scr, *, hidden, t_max):
+    # dw/db accumulate IN their fp32 output buffers (constant block
+    # mapping + sequential grid) instead of separate VMEM scratch — the
+    # extra [H, 4H] scratch copy pushed large shapes over the 16MB
+    # scoped-vmem limit (b64 h512 t64 in an 8-layer stack).
     k = pl.program_id(0)
     t = t_max - 1 - k
 
@@ -74,8 +78,8 @@ def _bwd_kernel(len_ref, x_ref, w_ref, b_ref, h0_ref, c0_ref,
     def _init():
         dh_scr[...] = jnp.zeros_like(dh_scr)
         dc_scr[...] = jnp.zeros_like(dc_scr)
-        dw_scr[...] = jnp.zeros_like(dw_scr)
-        db_scr[...] = jnp.zeros_like(db_scr)
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+        db_ref[...] = jnp.zeros_like(db_ref)
 
     # previous-step state: h_all/c_all blocks are indexed at t-1 via the
     # BlockSpec (clamped at 0); substitute h0/c0 when t == 0
@@ -111,10 +115,10 @@ def _bwd_kernel(len_ref, x_ref, w_ref, b_ref, h0_ref, c0_ref,
     dgates = jnp.concatenate([di_pre, dch_pre, df_pre, do_pre], axis=1)
 
     dx_ref[0] = dgates.astype(dx_ref.dtype)
-    dw_scr[...] += jax.lax.dot_general(
+    dw_ref[...] += jax.lax.dot_general(
         h_prev, dgates, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
-    db_scr[...] += jnp.sum(dgates, axis=0, keepdims=True)
+    db_ref[...] += jnp.sum(dgates, axis=0, keepdims=True)
 
     dh_prev = jax.lax.dot_general(
         dgates, w_ref[...].astype(jnp.float32),
@@ -125,8 +129,6 @@ def _bwd_kernel(len_ref, x_ref, w_ref, b_ref, h0_ref, c0_ref,
 
     @pl.when(k == t_max - 1)
     def _final():
-        dw_ref[...] = dw_scr[...].astype(dw_ref.dtype)
-        db_ref[...] = db_scr[...].astype(db_ref.dtype)
         dh0_ref[...] = dh_scr[...].astype(dh0_ref.dtype)
         dc0_ref[...] = dc_scr[...].astype(dc0_ref.dtype)
 
@@ -236,21 +238,22 @@ def _fused_lstm_bwd(interpret, res, grads):
         ],
         out_shape=[
             jax.ShapeDtypeStruct((t_max, bsz, g4), x.dtype),
-            jax.ShapeDtypeStruct((hidden, g4), w.dtype),
-            jax.ShapeDtypeStruct((1, g4), b.dtype),
+            # fp32 accumulators (cast to param dtype after the call) —
+            # accumulating 4H-wide sums in bf16 would lose precision
+            jax.ShapeDtypeStruct((hidden, g4), jnp.float32),
+            jax.ShapeDtypeStruct((1, g4), jnp.float32),
             jax.ShapeDtypeStruct((bsz, hidden), h0.dtype),
             jax.ShapeDtypeStruct((bsz, hidden), c0.dtype),
         ],
         scratch_shapes=[pltpu.VMEM((bsz, hidden), jnp.float32),
-                        pltpu.VMEM((bsz, hidden), jnp.float32),
-                        pltpu.VMEM((hidden, g4), jnp.float32),
-                        pltpu.VMEM((1, g4), jnp.float32)],
+                        pltpu.VMEM((bsz, hidden), jnp.float32)],
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
     )(lengths.astype(jnp.int32).reshape(bsz, 1), x, w,
       b.reshape(1, g4), h0, c0, h_all, c_all, dh_all, dc_all)
-    return dx, dw, db.reshape(g4), dh0, dc0, None
+    return dx, dw.astype(w.dtype), db.reshape(g4).astype(b.dtype), \
+        dh0, dc0, None
 
 
 fused_lstm.defvjp(_fused_lstm_fwd, _fused_lstm_bwd)

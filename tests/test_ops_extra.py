@@ -695,3 +695,41 @@ def test_debug_viz_utilities(tmp_path):
         pl.append("bogus", 0, 1.0)
     pl.reset()
     assert pl.series("train") == ([], [])
+
+
+def test_chunk_evaluator_streams_counts():
+    """ChunkEvaluator accumulates chunk_eval op counts across batches
+    (reference: evaluator.py ChunkEvaluator over chunk_eval_op)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.metrics import ChunkEvaluator
+    from paddle_tpu.core.lod import LoDTensor
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        inf = layers.data("inf", [1], dtype="int64", lod_level=1)
+        lab = layers.data("lab", [1], dtype="int64", lod_level=1)
+        _p, _r, _f, n_inf, n_lab, n_cor = layers.chunk_eval(
+            inf, lab, chunk_scheme="IOB", num_chunk_types=2)
+    exe = pt.Executor()
+    exe.run(startup)
+    ev = ChunkEvaluator()
+    # IOB with 2 types: tag = type*2 + pos (B=0, I=1); 4 = outside
+    # seq: perfect match batch, then a half-matching batch
+    perfect = [np.array([[0], [1], [4], [2]], np.int64)]
+    half_inf = [np.array([[0], [4], [2], [3]], np.int64)]
+    half_lab = [np.array([[0], [1], [2], [3]], np.int64)]
+    for inf_seqs, lab_seqs in [(perfect, perfect),
+                               (half_inf, half_lab)]:
+        ni, nl, nc = exe.run(
+            main, feed={"inf": LoDTensor.from_sequences(inf_seqs),
+                        "lab": LoDTensor.from_sequences(lab_seqs)},
+            fetch_list=[n_inf, n_lab, n_cor])
+        ev.update(ni, nl, nc)
+    p, r, f1 = ev.eval()
+    assert 0 < p <= 1 and 0 < r <= 1 and 0 < f1 <= 1
+    # batch 1: 2 chunks all correct; batch 2: inf has 2 chunks ({B0},
+    # {B1,I1}), label has 2 chunks ({B0 I0}, {B1 I1}) -> 1 correct
+    assert ev.num_correct_chunks == 3
+    assert ev.num_infer_chunks == 4 and ev.num_label_chunks == 4
+    np.testing.assert_allclose(f1, 0.75)
