@@ -6,6 +6,7 @@ from .ops import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
+from .csp import *  # noqa: F401,F403
 from . import math_op_patch
 
 math_op_patch.monkey_patch_variable()

@@ -1,0 +1,55 @@
+"""In-graph CSP channel layers (reference: fluid/concurrency.py
+make_channel/channel_send/channel_recv/channel_close building channel
+ops into programs). See ops/csp_ops.py for the host-callback lowering;
+`register_channel` bridges host `concurrency.Channel` objects into the
+graph so go() threads and in-graph ops share one channel.
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["make_channel", "channel_send", "channel_recv",
+           "channel_close"]
+
+
+def make_channel(dtype=None, capacity: int = 0):
+    """Create a channel inside the program; returns the channel var
+    (an int32 id routed to the host registry). `dtype` is accepted for
+    reference-API parity; values carry their own dtype."""
+    helper = LayerHelper("channel_create")
+    out = helper.create_tmp_variable("int32", shape=[])
+    helper.append_op(type="channel_create", outputs={"Out": out},
+                     attrs={"capacity": int(capacity)})
+    return out
+
+
+def channel_send(channel, value, timeout: float = -1.0):
+    """Send `value` into `channel` (blocks the program per rendezvous
+    semantics; timeout<0 waits forever). Returns the status var."""
+    helper = LayerHelper("channel_send")
+    status = helper.create_tmp_variable("int32", shape=[])
+    helper.append_op(type="channel_send",
+                     inputs={"Channel": channel, "X": value},
+                     outputs={"Status": status},
+                     attrs={"timeout": float(timeout)})
+    return status
+
+
+def channel_recv(channel, shape, dtype="float32", timeout: float = -1.0):
+    """Receive one value of static `shape`/`dtype` from `channel`."""
+    helper = LayerHelper("channel_recv")
+    out = helper.create_tmp_variable(dtype, shape=list(shape))
+    helper.append_op(type="channel_recv", inputs={"Channel": channel},
+                     outputs={"Out": out},
+                     attrs={"shape": [int(d) for d in shape],
+                            "dtype": dtype,
+                            "timeout": float(timeout)})
+    return out
+
+
+def channel_close(channel):
+    helper = LayerHelper("channel_close")
+    status = helper.create_tmp_variable("int32", shape=[])
+    helper.append_op(type="channel_close", inputs={"Channel": channel},
+                     outputs={"Status": status})
+    return status

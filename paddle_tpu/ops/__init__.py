@@ -21,3 +21,4 @@ from ..core.registry import OpRegistry
 
 def all_ops():
     return OpRegistry.all_ops()
+from . import csp_ops  # noqa: F401

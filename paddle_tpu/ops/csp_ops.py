@@ -1,0 +1,158 @@
+"""In-graph CSP channel ops (reference: channel_create/send/recv/close
+ops, paddle/fluid/operators/channel_*.cc + framework/channel.h:33, used
+by go/select programs).
+
+TPU-native form: device programs are pure, so channel STATE lives on the
+host (the same `concurrency.Channel` objects the Python API uses); the
+in-graph ops bridge to it with `jax.experimental.io_callback(ordered=True)`
+so sends/recvs keep program order inside one executed program and
+interoperate with host-side `go()` producers/consumers. Gradients do not
+flow through channels (the reference's channel ops are not differentiable
+either); recv needs a static shape/dtype attr, XLA's static-shape regime.
+
+Deadlock note: a recv on an empty channel BLOCKS the executed program
+(as the reference's ChannelReceive blocks its thread); pair in-graph
+recvs with host-side `go()` senders or buffered channels, and set
+`timeout` to fail fast instead.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..concurrency import Channel, ChannelClosed
+from ..core.registry import register_op
+from .core_ops import jnp_dtype
+
+# host channel registry: id -> Channel (in-graph ops reference channels
+# by integer id carried as a scalar tensor)
+_channels: Dict[int, Channel] = {}
+_lock = threading.Lock()
+_next_id = [1]
+
+
+def register_channel(ch: Channel) -> int:
+    """Expose an existing host Channel to in-graph ops; returns its id."""
+    with _lock:
+        cid = _next_id[0]
+        _next_id[0] += 1
+        _channels[cid] = ch
+    return cid
+
+
+def get_channel(cid: int) -> Channel:
+    ch = _channels.get(int(cid))
+    if ch is None:
+        raise KeyError(f"unknown channel id {int(cid)} (create it with "
+                       "channel_create or register_channel)")
+    return ch
+
+
+def _unregister(cid: int):
+    with _lock:
+        _channels.pop(int(cid), None)
+
+
+def _host_create(capacity):
+    return np.int32(register_channel(Channel(int(capacity))))
+
+
+def _host_send(cid, value, timeout):
+    ch = get_channel(int(cid))
+    t = float(timeout)
+    ok = ch.send(np.asarray(value), timeout=None if t < 0 else t)
+    if not ok:
+        raise TimeoutError(f"channel_send timed out after {t}s")
+    return np.int32(1)
+
+
+def _host_recv(cid, *, timeout, shape, dtype):
+    ch = get_channel(int(cid))
+    t = float(timeout)
+    value, ok = ch.recv(timeout=None if t < 0 else t)
+    if not ok:
+        if ch.closed:
+            # closed AND drained: this channel can never produce again —
+            # drop it from the registry so looped programs don't leak
+            _unregister(cid)
+            raise ChannelClosed("channel_recv on a closed, drained "
+                                "channel")
+        raise TimeoutError(f"channel_recv timed out after {t}s")
+    arr = np.asarray(value).astype(dtype, copy=False)
+    if arr.shape != shape:
+        raise ValueError(f"channel_recv expected shape {shape}, got "
+                         f"{arr.shape}")
+    return arr
+
+
+def _host_close(cid):
+    ch = get_channel(int(cid))
+    ch.close()
+    # unregister once nothing is left to drain (a close with buffered
+    # items keeps the id alive until a recv drains it)
+    with ch._mu:
+        drained = not ch._buf and not ch._handoff
+    if drained:
+        _unregister(cid)
+    return np.int32(1)
+
+
+@register_op("channel_create", stateful=True)
+def _channel_create(ctx):
+    capacity = int(ctx.attr("capacity", 0))
+    if capacity < 1:
+        # an unbuffered in-graph channel deadlocks by construction:
+        # ordered callbacks serialize, so a blocking rendezvous send can
+        # never meet its receiver within one program. Host-side
+        # unbuffered channels still work via register_channel + go().
+        raise ValueError(
+            "in-graph channel_create needs capacity >= 1 (unbuffered "
+            "rendezvous cannot complete inside one ordered program); "
+            "for unbuffered host channels use concurrency.Channel + "
+            "ops.csp_ops.register_channel")
+    cid = jax.experimental.io_callback(
+        functools.partial(_host_create, capacity),
+        jax.ShapeDtypeStruct((), jnp.int32), ordered=True)
+    ctx.set_output("Out", cid)
+
+
+@register_op("channel_send", stateful=True, no_grad_slots=["Channel", "X"])
+def _channel_send(ctx):
+    cid = ctx.input("Channel")
+    x = ctx.input("X")
+    timeout = float(ctx.attr("timeout", -1.0))
+    status = jax.experimental.io_callback(
+        lambda c, v: _host_send(c, v, timeout),
+        jax.ShapeDtypeStruct((), jnp.int32), cid, x, ordered=True)
+    ctx.set_output("Status", status)
+
+
+@register_op("channel_recv", stateful=True, no_grad_slots=["Channel"])
+def _channel_recv(ctx):
+    cid = ctx.input("Channel")
+    shape = tuple(int(d) for d in ctx.attr("shape"))
+    if any(d < 0 for d in shape):
+        raise ValueError(
+            f"channel_recv needs a fully static shape (got {shape}); "
+            "the batch dim cannot be -1 under XLA")
+    dtype = jnp_dtype(ctx.attr("dtype", "float32"))
+    timeout = float(ctx.attr("timeout", -1.0))
+    out = jax.experimental.io_callback(
+        functools.partial(_host_recv, timeout=timeout, shape=shape,
+                          dtype=np.dtype(dtype).name),
+        jax.ShapeDtypeStruct(shape, dtype), cid, ordered=True)
+    ctx.set_output("Out", out)
+
+
+@register_op("channel_close", stateful=True, no_grad_slots=["Channel"])
+def _channel_close(ctx):
+    cid = ctx.input("Channel")
+    status = jax.experimental.io_callback(
+        _host_close, jax.ShapeDtypeStruct((), jnp.int32), cid,
+        ordered=True)
+    ctx.set_output("Status", status)

@@ -166,3 +166,60 @@ def test_select_send_on_closed_raises():
     ch.close()
     with pytest.raises(ChannelClosed):
         select([("send", ch, (1, None))])
+
+
+# -- in-graph channel ops (ops/csp_ops.py + layers/csp.py) ------------------
+import numpy as np  # noqa: E402
+
+def test_ingraph_channel_roundtrip_single_program():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        ch = layers.make_channel(capacity=4)
+        layers.channel_send(ch, x)
+        doubled = layers.scale(x, scale=2.0)
+        layers.channel_send(ch, doubled)
+        a = layers.channel_recv(ch, shape=[2, 4])
+        b = layers.channel_recv(ch, shape=[2, 4])
+        out = layers.elementwise_add(a, b)
+        layers.channel_close(ch)
+    exe = pt.Executor()
+    exe.run(startup)
+    xs = np.arange(8, dtype=np.float32).reshape(2, 4)
+    (o,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    # FIFO: recv order == send order, so out = x + 2x
+    np.testing.assert_allclose(np.asarray(o), 3.0 * xs)
+
+
+def test_ingraph_channel_bridges_host_go_producer():
+    """A host-side go() thread feeds a channel the PROGRAM consumes —
+    the reference's go_op + channel_recv pattern."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.concurrency import Channel, go
+    from paddle_tpu.ops.csp_ops import register_channel
+
+    host_ch = Channel(capacity=2)
+    cid = register_channel(host_ch)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ch = layers.fill_constant([], "int32", cid)
+        v = layers.channel_recv(ch, shape=[3], timeout=20.0)
+        out = layers.scale(v, scale=10.0)
+    exe = pt.Executor()
+    exe.run(startup)
+
+    sent = np.array([1.0, 2.0, 3.0], np.float32)
+    go(lambda: host_ch.send(sent))
+    (o,) = exe.run(main, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(o), 10.0 * sent)
+
+    # closed+drained channel: the in-graph recv surfaces the error
+    host_ch.close()
+    import pytest
+    with pytest.raises(Exception, match="closed"):
+        exe.run(main, fetch_list=[out])

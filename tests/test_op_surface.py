@@ -47,11 +47,8 @@ REPLACED = {
     "split_selected_rows": "shard_map row routing",
     "parallel_do": "GSPMD batch sharding",
     "get_places": "jax.devices()/mesh",
-    # CSP ops are host-side by design
-    "channel_create": "concurrency.Channel",
-    "channel_send": "concurrency.Channel.send",
-    "channel_recv": "concurrency.Channel.recv",
-    "channel_close": "concurrency.Channel.close",
+    # go/select orchestration stays host-side (channel ops are now
+    # registered in-graph via io_callback, ops/csp_ops.py)
     "go": "concurrency.go",
     "select": "concurrency.select",
     # readers are host-side pipeline + native loader
