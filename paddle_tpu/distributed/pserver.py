@@ -32,6 +32,19 @@ import numpy as np
 __all__ = ["AsyncParameterServer", "PServerServer", "PServerClient"]
 
 
+class _SyncRound:
+    """Fan-in accumulator for one parameter's sync-push barrier."""
+
+    __slots__ = ("grad_sum", "count", "round_id", "cond", "aborted")
+
+    def __init__(self):
+        self.grad_sum = None
+        self.count = 0
+        self.round_id = 0
+        self.cond = threading.Condition()
+        self.aborted = set()
+
+
 class _HostOptimizer:
     """Per-parameter host update rules (reference: the pserver applies
     optimizer steps server-side — ParameterServer2 doOperation :383,
@@ -114,8 +127,7 @@ class AsyncParameterServer:
         self._locks: Dict[str, threading.Lock] = {}
         self._versions: Dict[str, int] = {}
         self._init_done = threading.Event()
-        # sync-mode accumulators: name -> [sum_grad, count, round, cond]
-        self._sync: Dict[str, list] = {}
+        self._sync: Dict[str, _SyncRound] = {}
         self._global_lock = threading.Lock()
 
     # -- init protocol (reference: go/pserver InitParam/FinishInitParams,
@@ -134,7 +146,7 @@ class AsyncParameterServer:
             self._state[name] = self._opt.make_state(arr)
             self._locks[name] = threading.Lock()
             self._versions[name] = 0
-            self._sync[name] = [None, 0, 0, threading.Condition(), set()]
+            self._sync[name] = _SyncRound()
 
     def finish_init(self) -> None:
         self._init_done.set()
@@ -175,38 +187,38 @@ class AsyncParameterServer:
                                       self._state[name], grad)
                 self._versions[name] += 1
                 return self._versions[name]
-        # acc: [grad_sum, count, round_id, cond, aborted_round_ids]
         acc = self._sync[name]
-        cond: threading.Condition = acc[3]
-        with cond:
-            my_round = acc[2]
-            acc[0] = grad.astype(np.float64) if acc[0] is None \
-                else acc[0] + grad
-            acc[1] += 1
-            if acc[1] >= num_trainers:
-                mean = (acc[0] / acc[1]).astype(self._params[name].dtype)
+        with acc.cond:
+            my_round = acc.round_id
+            acc.grad_sum = grad.astype(np.float64) \
+                if acc.grad_sum is None else acc.grad_sum + grad
+            acc.count += 1
+            if acc.count >= num_trainers:
+                mean = (acc.grad_sum / acc.count).astype(
+                    self._params[name].dtype)
                 with self._locks[name]:
                     self._opt.apply_dense(self._params[name],
                                           self._state[name], mean)
                     self._versions[name] += 1
-                acc[0], acc[1] = None, 0
-                acc[2] += 1
-                cond.notify_all()
+                acc.grad_sum, acc.count = None, 0
+                acc.round_id += 1
+                acc.cond.notify_all()
             else:
-                done = cond.wait_for(lambda: acc[2] > my_round,
-                                     timeout=self._sync_timeout)
-                if not done and acc[2] == my_round:
+                done = acc.cond.wait_for(
+                    lambda: acc.round_id > my_round,
+                    timeout=self._sync_timeout)
+                if not done and acc.round_id == my_round:
                     # a peer died mid-round: abort THIS round (if a later
                     # round already started, leave it alone), drop the
                     # partial sum, and wake co-contributors so they fail
                     # too instead of being credited into a future round
-                    acc[0], acc[1] = None, 0
-                    acc[2] += 1
-                    acc[4].add(my_round)
-                    if len(acc[4]) > 64:
-                        acc[4].discard(min(acc[4]))
-                    cond.notify_all()
-                if my_round in acc[4]:
+                    acc.grad_sum, acc.count = None, 0
+                    acc.round_id += 1
+                    acc.aborted.add(my_round)
+                    if len(acc.aborted) > 64:
+                        acc.aborted.discard(min(acc.aborted))
+                    acc.cond.notify_all()
+                if my_round in acc.aborted:
                     raise RuntimeError(
                         f"sync push barrier for {name!r} timed out after "
                         f"{self._sync_timeout}s with {num_trainers} "
@@ -298,8 +310,7 @@ class AsyncParameterServer:
                     self._state.setdefault(n, self._opt.make_state(arr))
                     self._locks.setdefault(n, threading.Lock())
                     self._versions.setdefault(n, 0)
-                    self._sync.setdefault(
-                        n, [None, 0, 0, threading.Condition(), set()])
+                    self._sync.setdefault(n, _SyncRound())
         self._init_done.set()
 
 

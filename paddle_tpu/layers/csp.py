@@ -15,7 +15,11 @@ __all__ = ["make_channel", "channel_send", "channel_recv",
 def make_channel(dtype=None, capacity: int = 0):
     """Create a channel inside the program; returns the channel var
     (an int32 id routed to the host registry). `dtype` is accepted for
-    reference-API parity; values carry their own dtype."""
+    reference-API parity; values carry their own dtype. In-graph
+    channels must be buffered (capacity >= 1) — the op rejects
+    unbuffered ones at trace time, since ordered callbacks cannot
+    rendezvous within one program (use concurrency.Channel +
+    ops.csp_ops.register_channel for host-side unbuffered channels)."""
     helper = LayerHelper("channel_create")
     out = helper.create_tmp_variable("int32", shape=[])
     helper.append_op(type="channel_create", outputs={"Out": out},

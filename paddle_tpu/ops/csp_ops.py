@@ -62,7 +62,7 @@ def _host_create(capacity):
     return np.int32(register_channel(Channel(int(capacity))))
 
 
-def _host_send(cid, value, timeout):
+def _host_send(cid, value, *, timeout):
     ch = get_channel(int(cid))
     t = float(timeout)
     ok = ch.send(np.asarray(value), timeout=None if t < 0 else t)
@@ -127,7 +127,7 @@ def _channel_send(ctx):
     x = ctx.input("X")
     timeout = float(ctx.attr("timeout", -1.0))
     status = jax.experimental.io_callback(
-        lambda c, v: _host_send(c, v, timeout),
+        functools.partial(_host_send, timeout=timeout),
         jax.ShapeDtypeStruct((), jnp.int32), cid, x, ordered=True)
     ctx.set_output("Status", status)
 
