@@ -18,7 +18,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.executor import Executor, CompiledProgram, trace_block
-from ..core.lod import RaggedPair
+from ..core.lod import RaggedNested, RaggedPair
 from ..core.scope import Scope, global_scope
 from .mesh import get_mesh, make_mesh
 
@@ -38,7 +38,11 @@ class ShardingSpec:
 
     def feed_spec(self, name: str, ndim: int) -> P:
         if name in self.specs:
-            return self.specs[name]
+            # a ragged feed's companion lengths arrays are lower-rank
+            # than its data: truncate the user's spec to this rank so
+            # the leading (batch) axes still shard consistently
+            spec = tuple(self.specs[name])
+            return P(*spec[:ndim])
         if ndim == 0:
             return P()
         return P(self.feed_axis, *([None] * (ndim - 1)))
@@ -98,6 +102,12 @@ class ParallelExecutor(Executor):
                 feed_shardings[name] = RaggedPair(
                     NamedSharding(mesh, self.sharding.feed_spec(name, ndim)),
                     NamedSharding(mesh, self.sharding.feed_spec(name, 1)))
+            elif sig[0] == "ragged2":
+                ndim = len(sig[1])
+                feed_shardings[name] = RaggedNested(
+                    NamedSharding(mesh, self.sharding.feed_spec(name, ndim)),
+                    NamedSharding(mesh, self.sharding.feed_spec(name, 1)),
+                    NamedSharding(mesh, self.sharding.feed_spec(name, 2)))
             else:
                 ndim = len(sig[0])
                 feed_shardings[name] = NamedSharding(

@@ -151,3 +151,47 @@ def test_nested_flatten_gradient_flows():
         num = (f(jnp.asarray(dp)) - f(jnp.asarray(dm))) / (2 * eps)
         np.testing.assert_allclose(np.asarray(g)[idx], float(num),
                                    rtol=2e-2, atol=2e-3)
+
+
+def test_nested_feed_under_parallel_executor():
+    """RaggedNested feeds shard over the data axis in the GSPMD path
+    (batch dim sharded, lengths sharded alike)."""
+    import jax
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.executor import ParallelExecutor
+    from paddle_tpu.parallel.mesh import set_mesh
+
+    rng = np.random.RandomState(5)
+    # 8 outer sequences so the batch divides over 8 virtual devices
+    nested = []
+    for i in range(8):
+        subs = [rng.rand(rng.randint(1, 4), 4).astype(np.float32)
+                for _ in range(rng.randint(1, 4))]
+        nested.append(subs)
+    t = LoDTensor.from_nested_sequences(nested)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32", lod_level=2)
+        pooled = layers.sequence_pool(x, "sum")
+        outer = layers.sequence_pool(pooled, "sum")
+        total = layers.reduce_sum(outer)
+    mesh = make_mesh((8,), ("data",), devices=jax.devices()[:8])
+    try:
+        exe = ParallelExecutor(mesh=mesh)
+        pt.Executor().run(startup)
+        (tv,) = exe.run(main, feed={"x": t}, fetch_list=[total])
+        want = sum(s.sum() for outer_seq in nested for s in outer_seq)
+        np.testing.assert_allclose(float(np.ravel(np.asarray(tv))[0]),
+                                   want, rtol=1e-5)
+    finally:
+        set_mesh(None)
+
+
+def test_feed_spec_truncates_to_lengths_rank():
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.parallel.executor import ShardingSpec
+    s = ShardingSpec(specs={"x": P("data", None, None, None)})
+    assert tuple(s.feed_spec("x", 4)) == ("data", None, None, None)
+    assert tuple(s.feed_spec("x", 2)) == ("data", None)
+    assert tuple(s.feed_spec("x", 1)) == ("data",)
