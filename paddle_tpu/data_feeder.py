@@ -37,7 +37,9 @@ class DataFeeder:
             lod_level = 0 if isinstance(var, str) else (var.lod_level or 0)
             dtype = "float32" if isinstance(var, str) else var.dtype
             column = [sample[i] for sample in batch]
-            if lod_level > 0:
+            if lod_level >= 2:
+                out[name] = self._nested(name, column, dtype, var)
+            elif lod_level > 0:
                 out[name] = self._ragged(name, column, dtype, var)
             else:
                 arr = np.asarray(column, dtype=np.dtype(dtype))
@@ -53,6 +55,44 @@ class DataFeeder:
                     arr.flags.writeable = False
                 out[name] = arr
         return out
+
+    def _nested(self, name, column, dtype, var):
+        """lod_level=2 var: each sample is a list of sub-sequences
+        (paragraph -> sentences -> tokens); -> RaggedNested via the
+        2-level LoDTensor conversion. Applies the same flat-token
+        reshape convention, max_lens cap (token level), and
+        pad_multiple bucketing as the level-1 path."""
+        from .core.lod import RaggedNested
+        np_dtype = np.dtype(dtype)
+        feat = None
+        if not isinstance(var, str) and var.shape:
+            feat = [d for d in var.shape[1:] if d and d > 0]
+        max_tok = self.max_lens.get(name)
+        nested = []
+        longest_tok = 1
+        longest_sub = 1
+        for sample in column:
+            subs = []
+            for seq in sample:
+                a = np.asarray(seq, np_dtype)
+                if feat and a.ndim == 1:
+                    a = a.reshape(len(a) // int(np.prod(feat)), *feat) \
+                        if np.prod(feat) > 1 else a.reshape(len(a), *feat)
+                elif a.ndim == 1:
+                    a = a.reshape(len(a), 1)
+                if max_tok is not None:
+                    a = a[:max_tok]  # hard cap truncates (bucketing)
+                subs.append(a)
+                longest_tok = max(longest_tok, a.shape[0])
+            nested.append(subs)
+            longest_sub = max(longest_sub, len(subs))
+        m = self.pad_multiple
+        pad_tok = max_tok if max_tok is not None else \
+            ((longest_tok + m - 1) // m) * m
+        data, sub_l, tok_l = LoDTensor.from_nested_sequences(
+            nested).to_nested_padded(max_sub=longest_sub,
+                                     max_tok=pad_tok)
+        return RaggedNested(data, sub_l, tok_l)
 
     def _ragged(self, name, column, dtype, var):
         np_dtype = np.dtype(dtype)

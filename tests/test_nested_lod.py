@@ -195,3 +195,63 @@ def test_feed_spec_truncates_to_lengths_rank():
     assert tuple(s.feed_spec("x", 4)) == ("data", None, None, None)
     assert tuple(s.feed_spec("x", 2)) == ("data", None)
     assert tuple(s.feed_spec("x", 1)) == ("data",)
+
+
+def test_data_feeder_builds_nested_feeds():
+    """DataFeeder converts per-sample lists-of-sub-sequences for
+    lod_level=2 vars into RaggedNested (reference DataFeeder recursive
+    LoD handling)."""
+    from paddle_tpu.data_feeder import DataFeeder
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        docs = layers.data("docs", [1], dtype="int64", lod_level=2)
+        label = layers.data("label", [1], dtype="int64")
+        pooled = layers.sequence_pool(docs, "sum")
+    feeder = DataFeeder(feed_list=[docs, label])
+    batch = [
+        ([[1, 2], [3]], [0]),           # doc with 2 sentences
+        ([[4, 5, 6]], [1]),             # doc with 1 sentence
+    ]
+    feed = feeder.feed(batch)
+    x = feed["docs"]
+    assert isinstance(x, RaggedNested)
+    assert x.data.shape[0] == 2 and x.sub_lengths.tolist() == [2, 1]
+    assert x.tok_lengths.tolist()[0][:2] == [2, 1]
+    # and it executes
+    exe = pt.Executor()
+    exe.run(startup)
+    (pv,) = exe.run(main, feed=feed, fetch_list=[pooled])
+    got = [row for s in pv.sequences() for row in s]
+    np.testing.assert_allclose(
+        np.ravel(got), [1 + 2, 3, 4 + 5 + 6])
+
+
+def test_data_feeder_nested_buckets_and_caps():
+    """pad_multiple stabilizes the token axis (one compile signature
+    across batches) and max_lens truncates, as in the level-1 path."""
+    from paddle_tpu.data_feeder import DataFeeder
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        docs = layers.data("docs", [1], dtype="int64", lod_level=2)
+    feeder = DataFeeder(feed_list=[docs], pad_multiple=8)
+    shapes = set()
+    for batch in [[([[1, 2], [3]],)], [([[4, 5, 6]],)],
+                  [([[7]], ), ([[1, 2, 3, 4, 5]],)]]:
+        x = feeder.feed(batch)["docs"]
+        shapes.add(x.data.shape[2])   # token axis
+    assert shapes == {8}, shapes      # bucketed, stable
+
+    capped = DataFeeder(feed_list=[docs], max_lens={"docs": 3})
+    x = capped.feed([([[1, 2, 3, 4, 5, 6]],)])["docs"]
+    assert x.data.shape[2] == 3 and x.tok_lengths.max() == 3
+
+    # flat-token convention with declared feature dims matches _ragged
+    main2, startup2 = pt.Program(), pt.Program()
+    with pt.program_guard(main2, startup2):
+        v = layers.data("v", [4], dtype="float32", lod_level=2)
+    f2 = DataFeeder(feed_list=[v])
+    y = f2.feed([([list(range(8))],)])["v"]   # 8 floats = 2 tokens x 4
+    assert y.data.shape[3] == 4 and y.tok_lengths.max() == 2, \
+        (y.data.shape, y.tok_lengths)
