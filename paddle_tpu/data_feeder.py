@@ -17,11 +17,14 @@ from .core.lod import LoDTensor, RaggedPair
 
 class DataFeeder:
     def __init__(self, feed_list: Sequence, place=None,
-                 pad_multiple: int = 16,
+                 pad_multiple: int = 16, sub_pad_multiple: int = 4,
                  max_lens: Optional[Dict[str, int]] = None,
                  freeze: bool = False):
         self.feed_vars = list(feed_list)
         self.pad_multiple = pad_multiple
+        # lod_level=2 sub-sequence axis bucketing (1 disables; keeps
+        # compile signatures stable when sentence counts vary)
+        self.sub_pad_multiple = max(1, int(sub_pad_multiple))
         self.max_lens = max_lens or {}
         # freeze=True returns read-only owning arrays, which the executor
         # caches device-side by identity — useful when the same batch is fed
@@ -56,6 +59,25 @@ class DataFeeder:
                 out[name] = arr
         return out
 
+    @staticmethod
+    def _feat_dims(var):
+        if not isinstance(var, str) and var.shape:
+            # declared [-1?, feat...]: per-step feature dims after batch
+            return [d for d in var.shape[1:] if d and d > 0]
+        return None
+
+    @staticmethod
+    def _to_step_array(seq, np_dtype, feat):
+        """One flat-or-shaped sequence -> [steps, *feat] (the shared
+        flat-token reshape convention of the level-1 and level-2 paths)."""
+        a = np.asarray(seq, np_dtype)
+        if feat and a.ndim == 1:
+            a = a.reshape(len(a) // int(np.prod(feat)), *feat) \
+                if np.prod(feat) > 1 else a.reshape(len(a), *feat)
+        elif a.ndim == 1:
+            a = a.reshape(len(a), 1)
+        return a
+
     def _nested(self, name, column, dtype, var):
         """lod_level=2 var: each sample is a list of sub-sequences
         (paragraph -> sentences -> tokens); -> RaggedNested via the
@@ -64,9 +86,7 @@ class DataFeeder:
         pad_multiple bucketing as the level-1 path."""
         from .core.lod import RaggedNested
         np_dtype = np.dtype(dtype)
-        feat = None
-        if not isinstance(var, str) and var.shape:
-            feat = [d for d in var.shape[1:] if d and d > 0]
+        feat = self._feat_dims(var)
         max_tok = self.max_lens.get(name)
         nested = []
         longest_tok = 1
@@ -74,12 +94,7 @@ class DataFeeder:
         for sample in column:
             subs = []
             for seq in sample:
-                a = np.asarray(seq, np_dtype)
-                if feat and a.ndim == 1:
-                    a = a.reshape(len(a) // int(np.prod(feat)), *feat) \
-                        if np.prod(feat) > 1 else a.reshape(len(a), *feat)
-                elif a.ndim == 1:
-                    a = a.reshape(len(a), 1)
+                a = self._to_step_array(seq, np_dtype, feat)
                 if max_tok is not None:
                     a = a[:max_tok]  # hard cap truncates (bucketing)
                 subs.append(a)
@@ -89,26 +104,19 @@ class DataFeeder:
         m = self.pad_multiple
         pad_tok = max_tok if max_tok is not None else \
             ((longest_tok + m - 1) // m) * m
+        # the sub-sequence axis buckets too so batches with varying
+        # sentence counts reuse compile signatures
+        m2 = self.sub_pad_multiple
+        pad_sub = ((longest_sub + m2 - 1) // m2) * m2
         data, sub_l, tok_l = LoDTensor.from_nested_sequences(
-            nested).to_nested_padded(max_sub=longest_sub,
-                                     max_tok=pad_tok)
+            nested).to_nested_padded(max_sub=pad_sub, max_tok=pad_tok)
         return RaggedNested(data, sub_l, tok_l)
 
     def _ragged(self, name, column, dtype, var):
         np_dtype = np.dtype(dtype)
-        feat = None
-        if not isinstance(var, str) and var.shape:
-            # declared [-1?, feat...]: per-step feature dims after batch
-            feat = [d for d in var.shape[1:] if d and d > 0]
-        arrs = []
-        for seq in column:
-            a = np.asarray(seq, np_dtype)
-            if feat and a.ndim == 1:
-                a = a.reshape(len(a) // int(np.prod(feat)), *feat) \
-                    if np.prod(feat) > 1 else a.reshape(len(a), *feat)
-            elif a.ndim == 1:
-                a = a.reshape(len(a), 1)
-            arrs.append(a)
+        feat = self._feat_dims(var)
+        arrs = [self._to_step_array(seq, np_dtype, feat)
+                for seq in column]
         max_len = self.max_lens.get(name)
         if max_len is None:
             longest = max((a.shape[0] for a in arrs), default=1)

@@ -237,11 +237,14 @@ def test_data_feeder_nested_buckets_and_caps():
         docs = layers.data("docs", [1], dtype="int64", lod_level=2)
     feeder = DataFeeder(feed_list=[docs], pad_multiple=8)
     shapes = set()
+    sub_shapes = set()
     for batch in [[([[1, 2], [3]],)], [([[4, 5, 6]],)],
-                  [([[7]], ), ([[1, 2, 3, 4, 5]],)]]:
+                  [([[7]], ), ([[1, 2], [3], [4, 5], [6]],)]]:
         x = feeder.feed(batch)["docs"]
-        shapes.add(x.data.shape[2])   # token axis
-    assert shapes == {8}, shapes      # bucketed, stable
+        shapes.add(x.data.shape[2])       # token axis
+        sub_shapes.add(x.data.shape[1])   # sub-sequence axis
+    assert shapes == {8}, shapes          # bucketed, stable
+    assert sub_shapes == {4}, sub_shapes  # sub axis buckets too
 
     capped = DataFeeder(feed_list=[docs], max_lens={"docs": 3})
     x = capped.feed([([[1, 2, 3, 4, 5, 6]],)])["docs"]
