@@ -36,6 +36,15 @@ BASELINE_IMAGES_PER_SEC = 81.69
 # batch 64, hidden 512, seq len 100 on 1x K40m => ~34.8k tokens/s.
 BASELINE_LSTM_TOKENS_PER_SEC = 64 * 100 / 0.184
 
+# MFU accounting (north star: >=50% MFU ResNet-50): v5e peak bf16
+# throughput per chip, and ResNet-50 training FLOPs per image
+# (~4.1 GFLOP forward at 224^2 x 3 for fwd+bwd).
+V5E_PEAK_FLOPS = 197e12
+RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.1e9
+# transformer-base MFU via the 6*N*D rule (N ~= 98M params incl.
+# embeddings for the bench config: 6 enc + 6 dec layers, d512, 32k vocab)
+TRANSFORMER_FLOPS_PER_TOKEN = 6 * 98e6
+
 BATCH = int(os.environ.get("BENCH_BATCH", "128"))
 WARMUP = int(os.environ.get("BENCH_WARMUP", "3"))
 N1 = int(os.environ.get("BENCH_N1", "5"))
@@ -166,10 +175,16 @@ def main():
             pt.reset_default_programs()
             pt.reset_global_scope()
             pt.amp.enable(amp_on)   # honor the PADDLE_TPU_AMP override
-            extras["transformer_tokens_per_sec"] = round(
-                bench_transformer(pt), 0)
+            t_tok_s = bench_transformer(pt)
+            extras["transformer_tokens_per_sec"] = round(t_tok_s, 0)
+            extras["transformer_mfu_est"] = round(
+                t_tok_s * TRANSFORMER_FLOPS_PER_TOKEN / V5E_PEAK_FLOPS,
+                3)
         except Exception as e:
             extras["transformer_error"] = repr(e)[:200]
+    extras["resnet_mfu_est"] = round(
+        images_per_sec * RESNET50_TRAIN_FLOPS_PER_IMAGE / V5E_PEAK_FLOPS,
+        3)
 
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec",

@@ -44,9 +44,9 @@ def _clamp_blocks(sq, sk, block_q, block_k, interpret):
 
     The requested block size acts as a CAP: the axis is split into the
     fewest blocks that respect it, then the block is shrunk to fit the
-    actual length so padding never exceeds one alignment unit (e.g.
-    sq=1100 with cap 1024 -> 2 blocks of 552 = 1104 padded rows, not 2
-    blocks of 1024 = 2048)."""
+    actual length so padding stays under one alignment unit PER BLOCK
+    (e.g. sq=1100 with cap 1024 -> 2 blocks of 552 = 1104 padded rows,
+    not 2 blocks of 1024 = 2048)."""
     if interpret:
         return min(block_q, _ceil_to(sq, 8)), min(block_k, _ceil_to(sk, 8))
     nq = -(-sq // max(block_q, 8))
@@ -480,11 +480,12 @@ def flash_attention(q, k, v, bias: Optional[jax.Array] = None,
     the gradient is then emitted from the dq kernel and summed over any
     broadcast dims.
 
-    Block defaults (None -> per-path cap below, shrunk to fit the
-    sequence by _clamp_blocks) were swept on v5e with stacked-layer
-    fwd+bwd marginal timing: 1024x1024 beat 128x128 by 1.4x at seq 256,
-    2.7x at 1024, and was still fastest at 4096. Explicitly passed
-    block sizes are honored unchanged.
+    block_q/block_k act as CAPS on the tile size: the sequence is split
+    into the fewest cap-respecting tiles and the tile shrinks to fit
+    (minimizing padding), so an explicit 256 with sq=900 runs 4 tiles
+    of 232. None selects the per-path default cap below, swept on v5e
+    with stacked-layer fwd+bwd marginal timing: 1024x1024 beat 128x128
+    by 1.4x at seq 256, 2.7x at 1024, and was still fastest at 4096.
     """
     if interpret is None:
         interpret = _interpret_default()
