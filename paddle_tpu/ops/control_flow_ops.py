@@ -282,7 +282,12 @@ def _pipeline(ctx):
                                          split_microbatches)
         micro = split_microbatches(x, n_micro)
         stacked = dict(zip(names, params))
-        out = pipeline_apply(stage_fn, stacked, micro, axis=axis, mesh=mesh)
+        # combined DP x PP: if the mesh also carries a 'data' axis, keep
+        # the microbatch dim sharded over it (each DP row pipelines its
+        # own batch shard; GSPMD reshards replicated feeds as needed)
+        batch_axis = "data" if "data" in mesh.axis_names else None
+        out = pipeline_apply(stage_fn, stacked, micro, axis=axis, mesh=mesh,
+                             batch_axis=batch_axis)
         out = merge_microbatches(out)
     else:
         a = x

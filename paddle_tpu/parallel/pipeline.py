@@ -40,7 +40,8 @@ except ImportError:  # pragma: no cover — older jax
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, micro_xs,
-                   axis: str = "pipe", mesh: Optional[Mesh] = None):
+                   axis: str = "pipe", mesh: Optional[Mesh] = None,
+                   batch_axis: Optional[str] = None):
     """Run `n_micro` microbatches through an `n_stages`-deep pipeline.
 
     stage_fn: (params_for_one_stage, x) -> y with y.shape == x.shape.
@@ -48,6 +49,10 @@ def pipeline_apply(stage_fn: Callable, stage_params, micro_xs,
         over `axis`; leaf i holds stage i's parameters).
     micro_xs: [n_micro, micro_batch, ...] input microbatches
         (replicated along `axis`).
+    batch_axis: optional second mesh axis the microbatch dim is sharded
+        over (combined DP x PP: each data-parallel row of the mesh runs
+        its own pipeline on its batch shard; params stay replicated
+        along it).
     Returns [n_micro, micro_batch, ...] outputs of the final stage.
 
     Schedule: n_micro + n_stages - 1 ticks. At tick t stage 0 ingests
@@ -113,10 +118,14 @@ def pipeline_apply(stage_fn: Callable, stage_params, micro_xs,
         outs = jnp.where(stage == last, outs, jnp.zeros_like(outs))
         return jax.lax.psum(outs, axis)
 
+    if batch_axis is not None and batch_axis not in mesh.axis_names:
+        raise ValueError(f"batch_axis {batch_axis!r} not in mesh axes "
+                         f"{mesh.axis_names}")
+    xs_spec = P(None, batch_axis) if batch_axis else P()
     return shard_map(
         per_stage, mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(),
+        in_specs=(P(axis), xs_spec),
+        out_specs=xs_spec,
     )(stage_params, micro_xs)
 
 

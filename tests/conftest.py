@@ -18,6 +18,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running (subprocess compile) tests")
+
+
 @pytest.fixture(autouse=True)
 def fresh_programs():
     """Each test builds graphs into fresh default programs and scope."""
