@@ -128,6 +128,12 @@ class Channel:
         with self._mu:
             return len(self._buf) + len(self._handoff)
 
+    def drained(self) -> bool:
+        """True when nothing is buffered or pending handoff — a closed,
+        drained channel can never produce a value again."""
+        with self._mu:
+            return not self._buf and not self._handoff
+
     def can_recv_now(self) -> bool:
         with self._mu:
             return bool(self._buf or self._handoff or self._closed)

@@ -15,6 +15,7 @@ in-place on device just like the reference's in-place kernels.
 from __future__ import annotations
 
 import os
+import warnings
 import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -32,10 +33,26 @@ STEP_VAR = "@step_counter@"
 
 # Parity with the reference's FLAGS_check_nan_inf (executor.cc:27,345-353).
 CHECK_NAN_INF = os.environ.get("PADDLE_TPU_CHECK_NAN_INF", "0") == "1"
-# Opt-in: raise when a bounded While loop hit its max_steps with the
-# condition still true (costs a per-run host readback of the flags).
+# A bounded While that hit max_steps with its condition still true warns
+# once per (program, flag) by default; PADDLE_TPU_CHECK_WHILE_BOUND=1
+# raises instead.
 CHECK_WHILE_BOUND = \
     os.environ.get("PADDLE_TPU_CHECK_WHILE_BOUND", "0") == "1"
+_WARNED_WHILE_FLAGS: set = set()
+
+
+def _check_while_flag(key, value, raise_: bool):
+    """key = (program uid, flag var name); value = the fetched bool."""
+    if not bool(np.asarray(value).reshape(-1)[0]):
+        return
+    msg = (f"bounded While loop flag {key[1]!r}: the loop hit max_steps "
+           "with its condition still true — it was truncated; raise "
+           "max_steps")
+    if raise_:
+        raise RuntimeError(msg)
+    if key not in _WARNED_WHILE_FLAGS:
+        _WARNED_WHILE_FLAGS.add(key)
+        warnings.warn(msg, RuntimeWarning, stacklevel=3)
 
 
 # Device-side cache for immutable feed arrays. Feeding over a slow host
@@ -104,16 +121,26 @@ def _to_device_value(value):
     return _maybe_cached(value)
 
 
+def _np_fetch(x) -> np.ndarray:
+    """Device -> numpy, widening bf16 to f32 at the fetch boundary: under
+    AMP activations live on device at half width, but numpy has no native
+    bfloat16 and the user-facing contract stays float32."""
+    arr = np.asarray(x)
+    if arr.dtype.name == "bfloat16":
+        arr = arr.astype(np.float32)
+    return arr
+
+
 def _to_host_value(value, return_numpy: bool):
     if isinstance(value, RaggedPair):
-        padded = np.asarray(value.data)
+        padded = _np_fetch(value.data)
         lengths = np.asarray(value.lengths)
         return LoDTensor.from_padded(padded, lengths)
     if isinstance(value, RaggedNested):
         return LoDTensor.from_nested_padded(
-            np.asarray(value.data), np.asarray(value.sub_lengths),
+            _np_fetch(value.data), np.asarray(value.sub_lengths),
             np.asarray(value.tok_lengths))
-    return np.asarray(value) if return_numpy else value
+    return _np_fetch(value) if return_numpy else value
 
 
 def _abstractify(value):
@@ -179,13 +206,20 @@ def _collect_state_names(program: Program, block: BlockDesc,
 
 
 class CompiledProgram:
-    """A jitted artifact for (program, feed signature, fetch list)."""
+    """A jitted artifact for (program, feed signature, fetch list).
 
-    def __init__(self, fn, read_names, write_names, fetch_names):
+    `jitted`/`ro_names`/`rw_names` expose the underlying jax.jit stage for
+    AOT introspection (profiler.cost_analysis, HLO dumps)."""
+
+    def __init__(self, fn, read_names, write_names, fetch_names,
+                 jitted=None, ro_names=(), rw_names=()):
         self.fn = fn
         self.read_names = read_names
         self.write_names = write_names
         self.fetch_names = fetch_names
+        self.jitted = jitted
+        self.ro_names = list(ro_names)
+        self.rw_names = list(rw_names)
 
 
 class Executor:
@@ -195,6 +229,10 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache: Dict[Tuple, CompiledProgram] = {}
+        # bounded-While truncation flags from the PREVIOUS run, checked
+        # one step later so the warn-by-default path never forces a
+        # device sync on the just-dispatched step
+        self._deferred_flags: List[Tuple[Tuple, Any]] = []
 
     # ------------------------------------------------------------------
     def _compile(self, program: Program, block: BlockDesc,
@@ -232,7 +270,9 @@ class Executor:
             rw = {n: state_vals[n] for n in rw_names}
             return jitted(feed_vals, ro, rw, step)
 
-        return CompiledProgram(call, read_names, write_names, fetch_names)
+        return CompiledProgram(call, read_names, write_names, fetch_names,
+                               jitted=jitted, ro_names=ro_names,
+                               rw_names=rw_names)
 
     # ------------------------------------------------------------------
     def run(self, program: Program, feed: Optional[Dict[str, Any]] = None,
@@ -252,17 +292,18 @@ class Executor:
         block = program.block(block_idx)
 
         n_user_fetches = len(fetch_names)
-        if CHECK_WHILE_BOUND:
-            # Auto-fetch every bounded-While exhaustion flag in this
-            # block (plain temps, not persistable state). Appended even
-            # when the user also fetches one — the checked tail must be
-            # complete. Limitation: a bounded While nested inside another
-            # sub-block keeps its flag block-local; propagate it to a
-            # parent var (assign) to check it here.
-            exhausted = [op.outputs["Exhausted"][0] for op in block.ops
-                         if op.type == "while"
-                         and op.outputs.get("Exhausted")]
-            fetch_names = fetch_names + exhausted
+        # Auto-fetch every bounded-While exhaustion flag in this block
+        # (plain temps, not persistable state). Appended even when the
+        # user also fetches one — the checked tail must be complete.
+        # Truncation warns once per flag by default; with
+        # PADDLE_TPU_CHECK_WHILE_BOUND=1 it raises instead. Limitation:
+        # a bounded While nested inside another sub-block keeps its flag
+        # block-local; propagate it to a parent var (assign) to check it
+        # here.
+        exhausted = [op.outputs["Exhausted"][0] for op in block.ops
+                     if op.type == "while"
+                     and op.outputs.get("Exhausted")]
+        fetch_names = fetch_names + exhausted
 
         feed_vals = {k: _to_device_value(v) for k, v in feed.items()}
         feed_sig = tuple(sorted((k, _abstractify(v))
@@ -284,16 +325,23 @@ class Executor:
         for n, v in new_state.items():
             scope.set(n, v)
 
-        results = [_to_host_value(v, return_numpy) for v in fetches]
+        flag_vals = list(zip(fetch_names[n_user_fetches:],
+                             fetches[n_user_fetches:]))
+        results = [_to_host_value(v, return_numpy)
+                   for v in fetches[:n_user_fetches]]
         if CHECK_WHILE_BOUND:
-            for n, v in zip(fetch_names[n_user_fetches:],
-                            results[n_user_fetches:]):
-                if bool(np.asarray(v).reshape(-1)[0]):
-                    raise RuntimeError(
-                        f"bounded While loop flag {n!r}: the loop hit "
-                        "max_steps with its condition still true — it "
-                        "was truncated; raise max_steps")
-            results = results[:n_user_fetches]
+            # enforced mode reads the flags synchronously so the raise
+            # points at the offending step
+            for n, v in flag_vals:
+                _check_while_flag((program.uid, n), v, raise_=True)
+        else:
+            # warn mode: check the previous step's flags (long since
+            # computed — reading them does not stall this step) and
+            # defer this step's to the next call / close()
+            for key, v in self._deferred_flags:
+                _check_while_flag(key, v, raise_=False)
+            self._deferred_flags = [((program.uid, n), v)
+                                    for n, v in flag_vals]
         if CHECK_NAN_INF:
             for n, v in zip(fetch_names, results):
                 arr = v.data if isinstance(v, LoDTensor) else v
@@ -304,4 +352,7 @@ class Executor:
         return results
 
     def close(self):
+        for key, v in self._deferred_flags:
+            _check_while_flag(key, v, raise_=False)
+        self._deferred_flags = []
         self._cache.clear()

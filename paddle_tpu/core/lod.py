@@ -140,6 +140,20 @@ def lengths_to_lod(lengths: Sequence[int]) -> List[int]:
     return out
 
 
+def _concat_or_empty(arrs: List[np.ndarray], feat_shape, dtype) -> np.ndarray:
+    """Concatenate sequence arrays; when there is no element to infer
+    feature dims/dtype from (no arrays, or every array empty and
+    feature-dim-less), fall back to the caller's hints so empty batches
+    stay rank/dtype-consistent with non-empty ones."""
+    if not arrs:
+        return np.zeros((0,) + tuple(feat_shape), dtype=dtype)
+    flat = np.concatenate(arrs, axis=0)
+    if flat.size == 0 and feat_shape and \
+            flat.shape[1:] != tuple(feat_shape):
+        return np.zeros((0,) + tuple(feat_shape), dtype=dtype)
+    return flat
+
+
 class LoDTensor:
     """Host-side ragged tensor: flat data + LoD offsets (reference parity).
 
@@ -152,8 +166,13 @@ class LoDTensor:
         self.lod = lod or []
 
     @classmethod
-    def from_sequences(cls, seqs: List[np.ndarray]) -> "LoDTensor":
-        flat = np.concatenate([np.asarray(s) for s in seqs], axis=0)
+    def from_sequences(cls, seqs: List[np.ndarray],
+                       feat_shape=(), dtype=np.float32) -> "LoDTensor":
+        """feat_shape/dtype only matter for an all-empty batch, where no
+        element exists to infer them from — without them the flat array
+        would be rank/dtype-inconsistent with non-empty batches."""
+        arrs = [np.asarray(s) for s in seqs]
+        flat = _concat_or_empty(arrs, feat_shape, dtype)
         return cls(flat, [lengths_to_lod([len(s) for s in seqs])])
 
     def sequences(self) -> List[np.ndarray]:
@@ -181,10 +200,12 @@ class LoDTensor:
     # ---- two-level (nested) conversions ---------------------------------
     @classmethod
     def from_nested_sequences(
-            cls, nested: List[List[np.ndarray]]) -> "LoDTensor":
-        """nested[i][j] = tokens of sub-sequence j of outer sequence i."""
+            cls, nested: List[List[np.ndarray]],
+            feat_shape=(), dtype=np.float32) -> "LoDTensor":
+        """nested[i][j] = tokens of sub-sequence j of outer sequence i.
+        feat_shape/dtype are the empty-batch hints (see from_sequences)."""
         subs = [np.asarray(s) for outer in nested for s in outer]
-        flat = np.concatenate(subs, axis=0) if subs else np.zeros((0,))
+        flat = _concat_or_empty(subs, feat_shape, dtype)
         lod0 = lengths_to_lod([len(outer) for outer in nested])
         lod1 = lengths_to_lod([len(s) for s in subs])
         return cls(flat, [lod0, lod1])

@@ -109,7 +109,8 @@ class DataFeeder:
         m2 = self.sub_pad_multiple
         pad_sub = ((longest_sub + m2 - 1) // m2) * m2
         data, sub_l, tok_l = LoDTensor.from_nested_sequences(
-            nested).to_nested_padded(max_sub=pad_sub, max_tok=pad_tok)
+            nested, feat_shape=feat, dtype=np_dtype).to_nested_padded(
+                max_sub=pad_sub, max_tok=pad_tok)
         return RaggedNested(data, sub_l, tok_l)
 
     def _ragged(self, name, column, dtype, var):
@@ -126,6 +127,7 @@ class DataFeeder:
             # a hard cap truncates (the standard bucketing behavior);
             # to_padded would otherwise fail on longer sequences
             arrs = [a[:max_len] for a in arrs]
-        lod = LoDTensor.from_sequences(arrs)
+        lod = LoDTensor.from_sequences(arrs, feat_shape=feat,
+                                       dtype=np_dtype)
         padded, lengths = lod.to_padded(max_len=max_len)
         return RaggedPair(padded, lengths)

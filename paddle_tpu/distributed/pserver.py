@@ -33,16 +33,39 @@ __all__ = ["AsyncParameterServer", "PServerServer", "PServerClient"]
 
 
 class _SyncRound:
-    """Fan-in accumulator for one parameter's sync-push barrier."""
+    """Fan-in accumulator for one parameter's sync-push barrier.
 
-    __slots__ = ("grad_sum", "count", "round_id", "cond", "aborted")
+    `outcomes` maps a resolved round id -> [applied, waiters_left]:
+    exact bookkeeping (each waiter consumes its slot; the entry is
+    dropped when the last one reads it), so an arbitrarily delayed
+    contributor always learns whether its round applied or aborted —
+    no trimmed-history window to fall out of."""
+
+    __slots__ = ("grad_sum", "count", "round_id", "cond", "outcomes")
 
     def __init__(self):
         self.grad_sum = None
         self.count = 0
         self.round_id = 0
         self.cond = threading.Condition()
-        self.aborted = set()
+        self.outcomes = {}
+
+    def resolve(self, applied: bool, waiters: int):
+        if waiters > 0:
+            self.outcomes[self.round_id] = [applied, waiters]
+        self.grad_sum, self.count = None, 0
+        self.round_id += 1
+        self.cond.notify_all()
+
+    def consume_outcome(self, round_id: int) -> bool:
+        """Read-and-release this waiter's slot; True = round applied."""
+        entry = self.outcomes.get(round_id)
+        if entry is None:  # resolver itself, or already-released slot
+            return True
+        entry[1] -= 1
+        if entry[1] <= 0:
+            del self.outcomes[round_id]
+        return entry[0]
 
 
 class _HostOptimizer:
@@ -200,9 +223,8 @@ class AsyncParameterServer:
                     self._opt.apply_dense(self._params[name],
                                           self._state[name], mean)
                     self._versions[name] += 1
-                acc.grad_sum, acc.count = None, 0
-                acc.round_id += 1
-                acc.cond.notify_all()
+                # resolver doesn't wait; the other count-1 contributors do
+                acc.resolve(applied=True, waiters=acc.count - 1)
             else:
                 done = acc.cond.wait_for(
                     lambda: acc.round_id > my_round,
@@ -211,14 +233,11 @@ class AsyncParameterServer:
                     # a peer died mid-round: abort THIS round (if a later
                     # round already started, leave it alone), drop the
                     # partial sum, and wake co-contributors so they fail
-                    # too instead of being credited into a future round
-                    acc.grad_sum, acc.count = None, 0
-                    acc.round_id += 1
-                    acc.aborted.add(my_round)
-                    if len(acc.aborted) > 64:
-                        acc.aborted.discard(min(acc.aborted))
-                    acc.cond.notify_all()
-                if my_round in acc.aborted:
+                    # too instead of being credited into a future round.
+                    # All acc.count arrived contributors (including this
+                    # aborter) are waiters on the outcome.
+                    acc.resolve(applied=False, waiters=acc.count)
+                if not acc.consume_outcome(my_round):
                     raise RuntimeError(
                         f"sync push barrier for {name!r} timed out after "
                         f"{self._sync_timeout}s with {num_trainers} "

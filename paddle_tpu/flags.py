@@ -42,9 +42,10 @@ FLAGS: Dict[str, tuple] = {
     "PADDLE_TPU_CHECK_WHILE_BOUND": (
         "0", "core/executor.py",
         "raise when a top-level bounded While (max_steps=N) truncated a "
-        "loop whose condition was still true (per-run host readback; "
-        "the `<name>.exhausted` bool var is always available to fetch; "
-        "loops nested in sub-blocks keep their flag block-local)"),
+        "loop whose condition was still true; default 0 warns once per "
+        "flag instead (per-run host readback; the `<name>.exhausted` "
+        "bool var is always available to fetch; loops nested in "
+        "sub-blocks keep their flag block-local)"),
     "PADDLE_TPU_DATA_HOME": (
         "~/.cache/paddle_tpu/dataset", "dataset/common.py",
         "dataset download/cache directory"),

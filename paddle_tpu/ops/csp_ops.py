@@ -30,7 +30,10 @@ from ..core.registry import register_op
 from .core_ops import jnp_dtype
 
 # host channel registry: id -> Channel (in-graph ops reference channels
-# by integer id carried as a scalar tensor)
+# by integer id carried as a scalar tensor). Unregistered ids leave a
+# tombstone so a late op on a swept channel still reads as "closed"
+# rather than "never existed"; ids are monotonic so tombstones are just
+# "allocated but absent".
 _channels: Dict[int, Channel] = {}
 _lock = threading.Lock()
 _next_id = [1]
@@ -46,9 +49,16 @@ def register_channel(ch: Channel) -> int:
 
 
 def get_channel(cid: int) -> Channel:
-    ch = _channels.get(int(cid))
+    cid = int(cid)
+    with _lock:
+        ch = _channels.get(cid)
+        allocated = 0 < cid < _next_id[0]
     if ch is None:
-        raise KeyError(f"unknown channel id {int(cid)} (create it with "
+        if allocated:
+            raise ChannelClosed(
+                f"channel id {cid} was closed and drained (its host "
+                "object has been released)")
+        raise KeyError(f"unknown channel id {cid} (create it with "
                        "channel_create or register_channel)")
     return ch
 
@@ -58,7 +68,23 @@ def _unregister(cid: int):
         _channels.pop(int(cid), None)
 
 
+def _gc_dead_channels():
+    """Drop closed, drained channels from the registry. channel_create
+    runs its callback on every program execution, so without this sweep
+    a program that closes with buffered items nobody drains would grow
+    the registry by one Channel per run. (A channel that is never closed
+    at all stays registered — the host cannot see device-side id refs,
+    so close is the lifetime signal, as in the reference's
+    channel_close_op.)"""
+    with _lock:
+        dead = [cid for cid, ch in _channels.items()
+                if ch.closed and ch.drained()]
+        for cid in dead:
+            _channels.pop(cid, None)
+
+
 def _host_create(capacity):
+    _gc_dead_channels()
     return np.int32(register_channel(Channel(int(capacity))))
 
 
@@ -95,9 +121,7 @@ def _host_close(cid):
     ch.close()
     # unregister once nothing is left to drain (a close with buffered
     # items keeps the id alive until a recv drains it)
-    with ch._mu:
-        drained = not ch._buf and not ch._handoff
-    if drained:
+    if ch.drained():
         _unregister(cid)
     return np.int32(1)
 

@@ -246,13 +246,29 @@ def test_bounded_while_exhaustion_flag():
     assert np.asarray(iv).item() == 5.0
     assert not np.asarray(ex).item()
 
-    # bound below the trip count: truncated, flag set
+    # bound below the trip count: truncated, flag set, and the default
+    # (non-raising) mode warns once per flag
+    import warnings as _warnings
+    from paddle_tpu.core import executor as _exmod
     main, startup, i, w = build(max_steps=3)
     exe = pt.Executor()
     exe.run(startup)
-    iv, ex = exe.run(main, fetch_list=[i, w.exhausted])
-    assert np.asarray(iv).item() == 3.0
-    assert np.asarray(ex).item()
+    _exmod._WARNED_WHILE_FLAGS.clear()
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        iv, ex = exe.run(main, fetch_list=[i, w.exhausted])
+        assert np.asarray(iv).item() == 3.0
+        assert np.asarray(ex).item()
+        # flag checks are deferred one step (no forced sync); the next
+        # run surfaces the truncation warning exactly once
+        exe.run(main, fetch_list=[i])
+        trunc = [c for c in caught if "max_steps" in str(c.message)]
+        assert len(trunc) == 1 and trunc[0].category is RuntimeWarning
+        # further runs: already warned for this flag — silent
+        exe.run(main, fetch_list=[i])
+        exe.close()
+        trunc = [c for c in caught if "max_steps" in str(c.message)]
+        assert len(trunc) == 1
 
     # executor-enforced mode
     from paddle_tpu.core import executor as exmod
