@@ -103,13 +103,22 @@ def make_multihost_mesh(ici_axes: Sequence[Tuple[str, int]],
     names = (dcn_axis,) + tuple(n for n, _ in ici_axes)
     ici_sizes = tuple(s for _, s in ici_axes)
     if n_hosts > 1:
-        from jax.experimental import mesh_utils
         from jax.sharding import Mesh
-        devices = mesh_utils.create_hybrid_device_mesh(
-            ici_sizes, (n_hosts,) + (1,) * (len(ici_sizes) - 1))
-        # hybrid mesh returns [dcn*ici...]-shaped array with DCN leading
-        mesh = Mesh(devices.reshape((n_hosts,) + ici_sizes), names)
         from ..parallel.mesh import set_mesh
+        try:
+            from jax.experimental import mesh_utils
+            devices = mesh_utils.create_hybrid_device_mesh(
+                ici_sizes, (n_hosts,) + (1,) * (len(ici_sizes) - 1))
+            # hybrid mesh returns [dcn*ici...]-shaped with DCN leading
+            devices = devices.reshape((n_hosts,) + ici_sizes)
+        except ValueError:
+            # emulated multi-process topologies (CPU devices carry no
+            # slice_index) — host-major order still puts cross-process
+            # traffic on the leading axis only
+            devs = sorted(jax.devices(),
+                          key=lambda d: (d.process_index, d.id))
+            devices = np.asarray(devs).reshape((n_hosts,) + ici_sizes)
+        mesh = Mesh(devices, names)
         set_mesh(mesh)
         return mesh
     return make_mesh((n_hosts,) + ici_sizes, names)

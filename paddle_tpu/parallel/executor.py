@@ -48,6 +48,20 @@ class ShardingSpec:
         return P(self.feed_axis, *([None] * (ndim - 1)))
 
 
+def _globalize(value, sharding):
+    """Multi-process SPMD: lift a process-local value (numpy array, or a
+    jax.Array committed to local devices — e.g. params the plain
+    Executor initialized from startup) into a global jax.Array laid out
+    by `sharding`. The value passed is this process's LOCAL part: the
+    full array for dims the sharding replicates across processes, the
+    local shard for dims it splits across them (standard per-host
+    data-parallel feeding). Already-global arrays pass through."""
+    if isinstance(value, jax.Array) and not value.is_fully_addressable:
+        return value  # already global
+    arr = np.asarray(value)
+    return jax.make_array_from_process_local_data(sharding, arr)
+
+
 class ParallelExecutor(Executor):
     def __init__(self, use_cuda: Optional[bool] = None,
                  loss_name: Optional[str] = None,
@@ -57,6 +71,37 @@ class ParallelExecutor(Executor):
         self.mesh = mesh or get_mesh() or make_mesh()
         self.sharding = sharding or ShardingSpec()
         self.loss_name = loss_name
+        # does the mesh span processes? (multi-host SPMD: feeds/state
+        # must be lifted to global arrays before entering the jit)
+        self._multiprocess = len(
+            {d.process_index for d in self.mesh.devices.flat}) > 1
+
+    def run(self, program, feed=None, **kw):
+        if self._multiprocess and feed:
+            feed = {
+                name: self._globalize_feed(name, v)
+                for name, v in feed.items()}
+        return super().run(program, feed=feed, **kw)
+
+    def _globalize_feed(self, name, v):
+        mesh = self.mesh
+        if isinstance(v, RaggedPair):
+            return RaggedPair(
+                _globalize(v.data, NamedSharding(
+                    mesh, self.sharding.feed_spec(name, v.data.ndim))),
+                _globalize(v.lengths, NamedSharding(
+                    mesh, self.sharding.feed_spec(name, 1))))
+        if isinstance(v, RaggedNested):
+            return RaggedNested(
+                _globalize(v.data, NamedSharding(
+                    mesh, self.sharding.feed_spec(name, v.data.ndim))),
+                _globalize(v.sub_lengths, NamedSharding(
+                    mesh, self.sharding.feed_spec(name, 1))),
+                _globalize(v.tok_lengths, NamedSharding(
+                    mesh, self.sharding.feed_spec(name, 2))))
+        arr = np.asarray(v)
+        return _globalize(arr, NamedSharding(
+            mesh, self.sharding.feed_spec(name, arr.ndim)))
 
     def _compile(self, program, block, feed_sig, fetch_names, scope):
         read_names, write_names = \
@@ -173,13 +218,27 @@ class ParallelExecutor(Executor):
             out_shardings=(fetch_out, state_out),
             donate_argnums=(2,))
 
+        multiprocess = self._multiprocess
+        step_sh = NamedSharding(mesh, P())
+
         def call(feed_vals, state_vals, step):
-            ro = {n: state_vals[n] for n in ro_names}
-            rw = {n: state_vals[n] for n in rw_names}
+            if multiprocess:
+                # state a plain Executor initialized (startup) lives on
+                # local devices; lift it to the global mesh once —
+                # thereafter the written-back state is already global
+                ro = {n: _globalize(state_vals[n], ro_shardings[n])
+                      for n in ro_names}
+                rw = {n: _globalize(state_vals[n], rw_shardings[n])
+                      for n in rw_names}
+                step = _globalize(step, step_sh)
+            else:
+                ro = {n: state_vals[n] for n in ro_names}
+                rw = {n: state_vals[n] for n in rw_names}
             return jitted(feed_vals, ro, rw, step)
 
         return CompiledProgram(call, read_names, write_names,
-                               fetch_names)
+                               fetch_names, jitted=jitted,
+                               ro_names=ro_names, rw_names=rw_names)
 
     @staticmethod
     def _state_names(program, block, scope):

@@ -1,0 +1,88 @@
+"""Worker process for the real 2-process SPMD test (spawned by
+test_multihost.py). Joins the job via the PADDLE_INIT_* contract, builds
+the DCN-outer mesh, trains fit_a_line data-parallel for one step on its
+LOCAL data shard, and checks the resulting parameters against the
+full-batch SGD update — which only matches if the gradient all-reduce
+crossed processes."""
+import os
+import sys
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from paddle_tpu.distributed.multihost import (init_multihost,
+                                                  make_multihost_mesh)
+    assert init_multihost(), "PADDLE_INIT_* contract not detected"
+    assert jax.process_count() == 2, jax.process_count()
+    n_local = jax.local_device_count()
+    assert jax.device_count() == 2 * n_local
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.parallel.executor import ParallelExecutor, ShardingSpec
+
+    mesh = make_multihost_mesh([("data", n_local)])
+    assert mesh.devices.shape == (2, n_local)
+    pid = jax.process_index()
+
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup):
+        x = layers.data("x", [13], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+        pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    w_name, b_name = [p.name for p in main_p.all_parameters()]
+
+    # startup runs per-process with identical seeds -> identical init
+    pt.Executor().run(startup)
+    scope = pt.global_scope()
+    w0 = np.asarray(scope.get(w_name)).copy()
+    b0 = np.asarray(scope.get(b_name)).copy()
+
+    # shared dataset; each process feeds only ITS half
+    rng = np.random.RandomState(42)
+    X = rng.randn(16, 13).astype(np.float32)
+    Y = (X @ rng.randn(13, 1) + 0.3).astype(np.float32)
+    half = X.shape[0] // 2
+    Xl = X[pid * half:(pid + 1) * half]
+    Yl = Y[pid * half:(pid + 1) * half]
+
+    pexe = ParallelExecutor(mesh=mesh, sharding=ShardingSpec(
+        feed_axis=("dcn", "data")))
+    (lv,) = pexe.run(main_p, feed={"x": Xl, "y": Yl}, fetch_list=[loss])
+    assert np.isfinite(float(np.asarray(lv).reshape(-1)[0]))
+
+    # expected: one SGD step on the FULL batch (both processes' data)
+    def sgd_step(Xb, Yb):
+        n = Xb.shape[0]
+        r = Xb @ w0 + b0 - Yb
+        dw = 2.0 / n * Xb.T @ r
+        db = 2.0 / n * r.sum(0)
+        return w0 - 0.1 * dw, b0 - 0.1 * db
+
+    w_exp, b_exp = sgd_step(X, Y)
+    w_loc, b_loc = sgd_step(Xl, Yl)  # what a non-communicating run gives
+    w1 = np.asarray(scope.get(w_name))
+    b1 = np.asarray(scope.get(b_name))
+    np.testing.assert_allclose(w1, w_exp, atol=2e-5)
+    np.testing.assert_allclose(b1, b_exp, atol=2e-5)
+    # the test must discriminate: local-only grads differ measurably
+    assert np.abs(w_exp - w_loc).max() > 1e-3, \
+        "degenerate data: local and global updates coincide"
+    assert not np.allclose(w1, w_loc, atol=1e-5)
+
+    # second step exercises the already-global state path
+    (lv2,) = pexe.run(main_p, feed={"x": Xl, "y": Yl}, fetch_list=[loss])
+    assert np.isfinite(float(np.asarray(lv2).reshape(-1)[0]))
+    print(f"MULTIHOST_WORKER_OK pid={pid} loss={float(np.asarray(lv)):.5f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,12 +1,23 @@
 """Multi-host env contract + DCN/ICI mesh layout
-(paddle_tpu/distributed/multihost.py). Actual multi-process join cannot
-run in CI; the env resolution and mesh layout rules are what we pin."""
+(paddle_tpu/distributed/multihost.py), plus a REAL 2-process SPMD run:
+two local processes jax.distributed.initialize via the PADDLE_INIT_*
+contract, build the DCN-outer mesh, and train fit_a_line data-parallel —
+the test fails unless the gradient all-reduce actually crosses processes
+(reference: multi-process-on-one-machine discipline of
+tests/book/test_fit_a_line.py:71-95)."""
+import os
+import socket
+import subprocess
+import sys
+
 import numpy as np
 import jax
 import pytest
 
 from paddle_tpu.distributed.multihost import (cluster_env,
                                               make_multihost_mesh)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_cluster_env_jax_native_spelling():
@@ -52,3 +63,42 @@ def test_cluster_env_rejects_out_of_range_pid():
 def test_cluster_env_partial_jax_spelling_raises():
     with pytest.raises(ValueError, match="NUM_PROCESSES"):
         cluster_env({"COORDINATOR_ADDRESS": "10.0.0.2:1234"})
+
+
+def test_two_process_spmd_gradient_allreduce():
+    """Two REAL processes join one jax.distributed job via the
+    PADDLE_INIT_* contract and train fit_a_line data-parallel; each
+    worker verifies the post-step params equal the full-batch update
+    (impossible without the cross-process gradient all-reduce)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # children pick their own device count
+        env.update({
+            "PADDLE_INIT_PSERVERS": "127.0.0.1",
+            "PADDLE_INIT_PORT": str(port),
+            "PADDLE_INIT_NUM_TRAINERS": "2",
+            "PADDLE_INIT_TRAINER_ID": str(pid),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests",
+                                          "multihost_worker.py")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
+        assert f"MULTIHOST_WORKER_OK pid={pid}" in out, out[-2000:]
