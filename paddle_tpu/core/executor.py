@@ -25,7 +25,7 @@ import jax.numpy as jnp
 
 from ..amp import amp_enabled
 from .ir import Program, BlockDesc, OpDesc
-from .lod import LoDTensor, RaggedNested, RaggedPair
+from .lod import LoDTensor, RaggedNested, RaggedPair, RaggedTree
 from .registry import run_op
 from .scope import Scope, global_scope
 
@@ -105,11 +105,16 @@ def _to_device_value(value):
         return RaggedNested(_maybe_cached(value.data),
                             _maybe_cached(value.sub_lengths),
                             _maybe_cached(value.tok_lengths))
+    if isinstance(value, RaggedTree):
+        return RaggedTree(_maybe_cached(value.data),
+                          tuple(_maybe_cached(l) for l in value.lengths))
     if isinstance(value, LoDTensor):
         if len(value.lod) > 2:
-            raise ValueError(
-                f"feeds support at most 2 LoD levels (got "
-                f"{len(value.lod)}); flatten outer levels on the host")
+            # arbitrary-depth LoD (lod_tensor.h:55-107): dense padded
+            # tree + per-level length arrays
+            data, lengths = value.to_tree_padded()
+            return RaggedTree(jnp.asarray(data),
+                              tuple(jnp.asarray(l) for l in lengths))
         if len(value.lod) == 2:
             data, sub_l, tok_l = value.to_nested_padded()
             return RaggedNested(jnp.asarray(data), jnp.asarray(sub_l),
@@ -140,6 +145,10 @@ def _to_host_value(value, return_numpy: bool):
         return LoDTensor.from_nested_padded(
             _np_fetch(value.data), np.asarray(value.sub_lengths),
             np.asarray(value.tok_lengths))
+    if isinstance(value, RaggedTree):
+        return LoDTensor.from_tree_padded(
+            _np_fetch(value.data),
+            [np.asarray(l) for l in value.lengths])
     return _np_fetch(value) if return_numpy else value
 
 
@@ -150,6 +159,9 @@ def _abstractify(value):
     if isinstance(value, RaggedNested):
         return ("ragged2", value.data.shape, str(value.data.dtype),
                 value.tok_lengths.shape)
+    if isinstance(value, RaggedTree):
+        return ("raggedk", len(value.lengths), value.data.shape,
+                str(value.data.dtype))
     return (tuple(value.shape), str(value.dtype))
 
 
@@ -222,6 +234,53 @@ class CompiledProgram:
         self.rw_names = list(rw_names)
 
 
+class _BlockPrefix:
+    """A view of a block truncated to its first `n` ops (the executor's
+    WhileGrad probe traces only the forward prefix up to the last
+    dynamic While)."""
+
+    def __init__(self, block: BlockDesc, n: int):
+        self._block = block
+        self.ops = list(block.ops[:n])
+
+    def __getattr__(self, name):
+        return getattr(self._block, name)
+
+
+def _dynamic_while_targets(block: BlockDesc):
+    """(while_id, steps_var_name) for every unbounded While this block
+    differentiates (a __vjp__ grad op replays it), plus the index one
+    past the last such forward While op — the probe prefix length."""
+    ids = set()
+    for op in block.ops:
+        if op.type != "__vjp__":
+            continue
+        fwd = op.attrs.get("fwd_op") or {}
+        if fwd.get("type") != "while":
+            continue
+        a = fwd.get("attrs") or {}
+        if int(a.get("max_steps", 0) or 0) <= 0 and a.get("dynamic_bound"):
+            ids.add(a.get("while_id"))
+    if not ids:
+        return {}, 0
+    targets, prefix = {}, 0
+    for i, op in enumerate(block.ops):
+        if op.type == "while" and op.attrs.get("while_id") in ids:
+            steps = op.outputs.get("Steps")
+            if not steps:
+                raise RuntimeError(
+                    f"dynamic While {op.attrs.get('while_id')!r} has no "
+                    "Steps output — rebuild the program with the "
+                    "current While layer")
+            targets[op.attrs["while_id"]] = steps[0]
+            prefix = i + 1
+    return targets, prefix
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, int(n) - 1).bit_length() if n > 1 else 1
+
+
 class Executor:
     """Runs Programs. `place` is accepted for API parity; JAX device
     selection is global (TPU if present, else CPU)."""
@@ -229,15 +288,60 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache: Dict[Tuple, CompiledProgram] = {}
+        self._probe_cache: Dict[Tuple, Any] = {}
         # bounded-While truncation flags from the PREVIOUS run, checked
         # one step later so the warn-by-default path never forces a
         # device sync on the just-dispatched step
         self._deferred_flags: List[Tuple[Tuple, Any]] = []
 
     # ------------------------------------------------------------------
+    def _probe_while_bounds(self, program: Program, block: BlockDesc,
+                            feed_vals, feed_sig, scope: Scope,
+                            block_idx: int, step):
+        """Probe-and-replay WhileGrad, phase 1 (reference analog:
+        while_op.cc:96 step scopes — there the forward RECORDS per-step
+        state; here, XLA-native, the forward prefix RE-RUNS to measure
+        each dynamic loop's trip count, and phase 2 recompiles the full
+        program with the bucketed bound baked into a differentiable
+        masked scan). State writes are discarded — the probe is pure.
+        Returns {while_id: bound} or None."""
+        targets, prefix = _dynamic_while_targets(block)
+        if not targets:
+            return None
+        steps_names = list(targets.values())
+        pkey = (program.uid, program.version, feed_sig, block_idx,
+                "__probe__")
+        probe = self._probe_cache.get(pkey)
+        if probe is None:
+            view = _BlockPrefix(block, prefix)
+            read_names, _ = _collect_state_names(program, view, scope)
+
+            def probe_fn(feed_vals, state, step):
+                env = dict(state)
+                env.update(feed_vals)
+                extra = {
+                    "program": program,
+                    "step": step,
+                    "keep_vars": set(steps_names),
+                    "prng": lambda seed: jax.random.fold_in(
+                        jax.random.PRNGKey(seed), step),
+                }
+                env = trace_block(view, env, extra)
+                return [env[n] for n in steps_names]
+
+            probe = (jax.jit(probe_fn), read_names)
+            self._probe_cache[pkey] = probe
+        jitted, read_names = probe
+        state = {n: scope.get(n) for n in read_names}
+        counts = jitted(feed_vals, state, step)
+        return {wid: _next_pow2(int(np.asarray(c)))
+                for wid, c in zip(targets, counts)}
+
+    # ------------------------------------------------------------------
     def _compile(self, program: Program, block: BlockDesc,
                  feed_sig, fetch_names: Sequence[str],
-                 scope: Scope) -> CompiledProgram:
+                 scope: Scope,
+                 while_bounds=None) -> CompiledProgram:
         read_names, write_names = _collect_state_names(program, block, scope)
         fetch_names = list(fetch_names)
         # Donate only buffers that are overwritten (param updates); read-only
@@ -258,6 +362,8 @@ class Executor:
                 "prng": lambda seed: jax.random.fold_in(
                     jax.random.PRNGKey(seed), step),
             }
+            if while_bounds:
+                extra["while_bounds"] = while_bounds
             env = trace_block(block, env, extra)
             fetches = [env[n] for n in fetch_names]
             new_state = {n: env[n] for n in write_names if n in env}
@@ -308,18 +414,26 @@ class Executor:
         feed_vals = {k: _to_device_value(v) for k, v in feed.items()}
         feed_sig = tuple(sorted((k, _abstractify(v))
                                 for k, v in feed_vals.items()))
-        key = (program.uid, program.version, feed_sig, tuple(fetch_names),
-               block_idx, amp_enabled())
-        compiled = self._cache.get(key)
-        if compiled is None:
-            compiled = self._compile(program, block, feed_sig, fetch_names,
-                                     scope)
-            self._cache[key] = compiled
-
-        state_vals = {n: scope.get(n) for n in compiled.read_names}
         step = scope.find(STEP_VAR)
         if step is None:
             step = jnp.zeros((), jnp.int32)
+
+        # unbounded-While gradients: measure trip counts with a forward
+        # probe, then compile with the bucketed bounds baked in
+        while_bounds = self._probe_while_bounds(
+            program, block, feed_vals, feed_sig, scope, block_idx, step)
+
+        key = (program.uid, program.version, feed_sig, tuple(fetch_names),
+               block_idx, amp_enabled(),
+               tuple(sorted(while_bounds.items())) if while_bounds
+               else None)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._compile(program, block, feed_sig, fetch_names,
+                                     scope, while_bounds=while_bounds)
+            self._cache[key] = compiled
+
+        state_vals = {n: scope.get(n) for n in compiled.read_names}
         fetches, new_state = compiled.fn(feed_vals, state_vals, step)
         scope.set(STEP_VAR, step + 1)
         for n, v in new_state.items():
@@ -356,3 +470,4 @@ class Executor:
             _check_while_flag(key, v, raise_=False)
         self._deferred_flags = []
         self._cache.clear()
+        self._probe_cache.clear()

@@ -11,17 +11,23 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..amp import amp_cast
+from ..amp import amp_cast, amp_enabled
 from ..core.registry import register_op
 from .core_ops import jnp_dtype
 
 
 def _mxu_matmul(x, y):
     """matmul that engages the MXU in one pass under AMP: bf16 operands,
-    float32 accumulation, float32 result."""
+    float32 accumulation, and a bf16 RESULT so activations thread
+    end-to-end at half width (the f32->bf16 rounding happens in the
+    matmul epilogue, fused — see MFU_BREAKDOWN.md)."""
     out_dtype = jnp.promote_types(x.dtype, y.dtype)
     x, y = amp_cast(x, y)
-    pref = jnp.float32 if x.dtype == jnp.bfloat16 == y.dtype else None
+    if x.dtype == jnp.bfloat16 == y.dtype and out_dtype == jnp.float32:
+        out_dtype = jnp.bfloat16
+        pref = jnp.float32
+    else:
+        pref = None
     return jnp.matmul(x, y, preferred_element_type=pref).astype(out_dtype)
 
 
@@ -43,6 +49,13 @@ def _register_elementwise(name, fn):
         x = ctx.input("X")
         y = ctx.input("Y")
         y = _broadcast_y(x, y, ctx.attr("axis", -1))
+        # Under AMP, bf16 wins mixed bf16/f32 elementwise ops (a f32
+        # bias/scale param would otherwise silently promote the whole
+        # activation stream back to f32 width).
+        if amp_enabled() and {getattr(x, "dtype", None),
+                              getattr(y, "dtype", None)} == \
+                {jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float32)}:
+            x, y = (a.astype(jnp.bfloat16) for a in (x, y))
         ctx.set_output("Out", _fn(x, y))
 
 
@@ -238,14 +251,18 @@ def _l2_normalize(ctx):
 
 @register_op("softmax")
 def _softmax(ctx):
-    ctx.set_output("Out", jax.nn.softmax(ctx.input("X"),
-                                         axis=ctx.attr("axis", -1)))
+    x = ctx.input("X")
+    xf = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+    out = jax.nn.softmax(xf, axis=ctx.attr("axis", -1))
+    ctx.set_output("Out", out.astype(x.dtype))
 
 
 @register_op("log_softmax")
 def _log_softmax(ctx):
-    ctx.set_output("Out", jax.nn.log_softmax(ctx.input("X"),
-                                             axis=ctx.attr("axis", -1)))
+    x = ctx.input("X")
+    xf = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+    out = jax.nn.log_softmax(xf, axis=ctx.attr("axis", -1))
+    ctx.set_output("Out", out.astype(x.dtype))
 
 
 # -- comparisons / logical --------------------------------------------------

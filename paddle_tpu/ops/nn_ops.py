@@ -9,6 +9,7 @@ for the MXU internally, so parity costs nothing on TPU.
 """
 from __future__ import annotations
 
+import functools
 import os
 
 import jax
@@ -36,11 +37,14 @@ def _conv_nhwc():
 
 
 def _conv2d_impl(x, w, strides, paddings, dilations, groups):
-    # Under AMP both operands drop to bf16; the MXU still accumulates in
-    # f32 internally, so only the final rounding is bf16 — then cast back.
-    # (preferred_element_type=f32 would keep the f32 rounding but its conv
-    # transpose rule rejects mixed-dtype cotangents, so full-bf16 it is.)
-    out_dtype = x.dtype
+    # Under AMP both operands drop to bf16 and the OUTPUT STAYS bf16:
+    # activations thread end-to-end at half width so every inter-op HBM
+    # buffer halves. (Round 1 cast each op's result back to f32; device
+    # traces showed the resulting convert_element_type fusions plus the
+    # doubled f32 traffic dominating the HBM-bound step — see
+    # MFU_BREAKDOWN.md. The MXU accumulates in f32 internally either
+    # way; preferred_element_type=f32's conv transpose rule rejects
+    # mixed-dtype cotangents, so full-bf16 it is.)
     x, w = amp_cast(x, w)
     nhwc = _conv_nhwc()
     if nhwc:
@@ -60,7 +64,7 @@ def _conv2d_impl(x, w, strides, paddings, dilations, groups):
     )
     if nhwc:
         out = jnp.transpose(out, (0, 3, 1, 2))
-    return out.astype(out_dtype)
+    return out
 
 
 @register_op("conv2d")
@@ -94,12 +98,11 @@ def _conv_transpose_impl(x, w, s, p, d, nd):
     pad = [(d[i] * (w.shape[2 + i] - 1) - p[i],) * 2 for i in range(nd)]
     dn = (("NCHW", "OIHW", "NCHW") if nd == 2
           else ("NCDHW", "OIDHW", "NCDHW"))
-    out_dtype = x.dtype
-    x, wk = amp_cast(x, wk)
+    x, wk = amp_cast(x, wk)  # bf16 in, bf16 out under AMP (see conv2d)
     return jax.lax.conv_general_dilated(
         x, wk, window_strides=(1,) * nd, padding=pad,
         lhs_dilation=s, rhs_dilation=d,
-        dimension_numbers=dn).astype(out_dtype)
+        dimension_numbers=dn)
 
 
 @register_op("conv2d_transpose")
@@ -148,15 +151,19 @@ def _pool2d(ctx):
         init = -jnp.inf
         out = jax.lax.reduce_window(x, init, jax.lax.max, dims, strides, pads)
     else:
-        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides,
+        # accumulate avg windows in f32 (bf16 inputs under AMP lose
+        # mantissa over 49-element global windows); the converts fuse
+        # into the reduce, so the HBM buffers stay input-width
+        xf = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+        summed = jax.lax.reduce_window(xf, 0.0, jax.lax.add, dims, strides,
                                        pads)
         if ctx.attr("exclusive", True) and (p[0] or p[1]):
-            ones = jnp.ones_like(x)
+            ones = jnp.ones_like(xf)
             counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
                                            strides, pads)
-            out = summed / counts
+            out = (summed / counts).astype(x.dtype)
         else:
-            out = summed / (k[0] * k[1])
+            out = (summed / (k[0] * k[1])).astype(x.dtype)
     ctx.set_output("Out", out)
 
 
@@ -176,6 +183,70 @@ def _adaptive_pool2d(ctx):
 
 # -- normalization ----------------------------------------------------------
 
+def _bn_bshape(x, ch_axis):
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+    return tuple(bshape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train(x, scale, bias, red_axes, eps):
+    (y, _m, _v), _res = _bn_train_fwd(x, scale, bias, red_axes, eps)
+    return y
+
+
+def _bn_train_fwd(x, scale, bias, red_axes, eps):
+    """Single-pass stats (sum / sum-of-squares fuse into ONE sweep over
+    x) + a coefficient-form normalize (y = x*a + b with per-channel a,b)
+    so the forward touches x exactly twice. The device trace showed the
+    autodiffed mean->var->normalize chain costing ~35% of the ResNet-50
+    step (MFU_BREAKDOWN.md); this plus the hand-derived 2-pass backward
+    halves BN's HBM traffic."""
+    ch_axis = [i for i in range(x.ndim) if i not in red_axes][0]
+    bshape = _bn_bshape(x, ch_axis)
+    n = 1
+    for i in red_axes:
+        n *= x.shape[i]
+    xf = x.astype(jnp.float32)
+    s1 = jnp.sum(xf, axis=red_axes)
+    s2 = jnp.sum(xf * xf, axis=red_axes)
+    mean = s1 / n
+    var = s2 / n - mean * mean          # biased, matching jnp.var
+    inv = jax.lax.rsqrt(var + eps)
+    a = scale * inv                      # [C] f32
+    b = bias - mean * a
+    y = (xf * a.reshape(bshape) + b.reshape(bshape)).astype(x.dtype)
+    return (y, mean, var), (x, scale, mean, inv)
+
+
+def _bn_train_bwd(red_axes, eps, res, dy):
+    x, scale, mean, inv = res
+    ch_axis = [i for i in range(x.ndim) if i not in red_axes][0]
+    bshape = _bn_bshape(x, ch_axis)
+    n = 1
+    for i in red_axes:
+        n *= x.shape[i]
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    xhat = (xf - mean.reshape(bshape)) * inv.reshape(bshape)
+    # pass 1: both channel reductions in one sweep over (x, dy)
+    dbias = jnp.sum(dyf, axis=red_axes)
+    dscale = jnp.sum(dyf * xhat, axis=red_axes)
+    # pass 2: dx
+    coef = (scale * inv).reshape(bshape)
+    dx = coef * (dyf - (dbias.reshape(bshape)
+                        + xhat * dscale.reshape(bshape)) / n)
+    return dx.astype(x.dtype), dscale, dbias
+
+
+def _bn_train_vjp_fwd(x, scale, bias, red_axes, eps):
+    (y, _m, _v), res = _bn_train_fwd(x, scale, bias, red_axes, eps)
+    return y, res
+
+
+_bn_train.defvjp(_bn_train_vjp_fwd, _bn_train_bwd)
+
+
 @register_op("batch_norm")
 def _batch_norm(ctx):
     """Inputs: X, Scale, Bias, Mean, Variance. Outputs: Y, MeanOut,
@@ -191,31 +262,35 @@ def _batch_norm(ctx):
 
     ch_axis = 1 if ctx.attr("data_layout", "NCHW") == "NCHW" else x.ndim - 1
     red_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
-    bshape = [1] * x.ndim
-    bshape[ch_axis] = x.shape[ch_axis]
+    bshape = _bn_bshape(x, ch_axis)
 
     if is_test:
-        mean, var = mean_in, var_in
-        saved_mean, saved_var = mean_in, var_in
-        mean_out, var_out = mean_in, var_in
-    else:
-        # Compute batch stats in f32 for stability under bf16 activations.
-        xf = x.astype(jnp.float32)
-        mean = jnp.mean(xf, axis=red_axes)
-        var = jnp.var(xf, axis=red_axes)
-        mean_out = mean_in * momentum + mean * (1 - momentum)
-        var_out = var_in * momentum + var * (1 - momentum)
-        saved_mean = mean
-        saved_var = 1.0 / jnp.sqrt(var + eps)
+        inv = jax.lax.rsqrt(var_in.astype(jnp.float32) + eps)
+        a = scale * inv
+        b = bias - mean_in * a
+        y = (x.astype(jnp.float32) * a.reshape(bshape)
+             + b.reshape(bshape)).astype(x.dtype)
+        ctx.set_output("Y", y)
+        ctx.set_output("MeanOut", mean_in)
+        ctx.set_output("VarianceOut", var_in)
+        ctx.set_output("SavedMean", mean_in)
+        ctx.set_output("SavedVariance", var_in)
+        return
 
-    inv = (1.0 / jnp.sqrt(var.astype(jnp.float32) + eps)).reshape(bshape)
-    y = (x.astype(jnp.float32) - mean.reshape(bshape)) * inv
-    y = y * scale.reshape(bshape) + bias.reshape(bshape)
-    ctx.set_output("Y", y.astype(x.dtype))
-    ctx.set_output("MeanOut", mean_out)
-    ctx.set_output("VarianceOut", var_out)
-    ctx.set_output("SavedMean", saved_mean)
-    ctx.set_output("SavedVariance", saved_var)
+    y = _bn_train(x, scale, bias, red_axes, eps)
+    # stats recomputed OUTSIDE the custom_vjp so running-stat updates
+    # carry no gradient plumbing; XLA CSEs them with the fwd pass sums
+    xf = x.astype(jnp.float32)
+    n = 1
+    for i in red_axes:
+        n *= x.shape[i]
+    mean = jnp.sum(xf, axis=red_axes) / n
+    var = jnp.sum(xf * xf, axis=red_axes) / n - mean * mean
+    ctx.set_output("Y", y)
+    ctx.set_output("MeanOut", mean_in * momentum + mean * (1 - momentum))
+    ctx.set_output("VarianceOut", var_in * momentum + var * (1 - momentum))
+    ctx.set_output("SavedMean", mean)
+    ctx.set_output("SavedVariance", jax.lax.rsqrt(var + eps))
 
 
 @register_op("layer_norm")
@@ -281,6 +356,7 @@ def _dropout(ctx):
 @register_op("cross_entropy", no_grad_slots=["Label"])
 def _cross_entropy(ctx):
     x = ctx.input("X")  # probabilities [N, C] (post-softmax)
+    x = x.astype(jnp.float32)  # log() of bf16 probs is too coarse
     label = ctx.input("Label")
     eps = 1e-8
     if ctx.attr("soft_label", False):
@@ -296,7 +372,7 @@ def _cross_entropy(ctx):
 
 @register_op("softmax_with_cross_entropy", no_grad_slots=["Label"])
 def _softmax_with_cross_entropy(ctx):
-    logits = ctx.input("Logits")
+    logits = ctx.input("Logits").astype(jnp.float32)
     label = ctx.input("Label")
     logp = jax.nn.log_softmax(logits, axis=-1)
     if ctx.attr("soft_label", False):
