@@ -138,16 +138,24 @@ def _generic_grad_op(op: OpDesc, block: BlockDesc, acc: _GradAccumulator,
 
     if op.type == "while" and \
             not (isinstance(op.attrs.get("max_steps"), int)
-                 and op.attrs.get("max_steps", 0) > 0):
+                 and op.attrs.get("max_steps", 0) > 0) and \
+            not op.attrs.get("dynamic_bound"):
         # lax.while_loop has no reverse-mode rule; the reference's
         # WhileGrad (while_op.cc:96) replays step scopes. The trainable
-        # paths here: While(cond, max_steps=N) (bounded-scan lowering,
-        # differentiable) or the scan-based DynamicRNN / StaticRNN.
+        # paths: While(cond, max_steps=N) (bounded-scan lowering), a
+        # top-level While(cond) under the executor's probe-and-replay
+        # (dynamic_bound - the executor measures the trip count with a
+        # forward probe and bakes a bucketed bound into the compile), or
+        # the scan-based DynamicRNN / StaticRNN. Only While ops built
+        # without the dynamic_bound attr (e.g. loaded from old PTIR)
+        # land here.
         raise NotImplementedError(
-            "gradients through an unbounded While loop are not "
+            "gradients through this unbounded While loop are not "
             "supported: pass max_steps=N to While (bounded, "
-            "differentiable scan lowering), use DynamicRNN / StaticRNN "
-            "for recurrences, or mark the loop's inputs stop_gradient")
+            "differentiable scan lowering), rebuild it with the current "
+            "While layer (executor probe-and-replay), use DynamicRNN / "
+            "StaticRNN for recurrences, or mark the loop's inputs "
+            "stop_gradient")
 
     out_grad_names = [acc.materialize(n)
                       for n, h in zip(fwd_out_names, out_has_grad) if h]
@@ -178,9 +186,30 @@ def _generic_grad_op(op: OpDesc, block: BlockDesc, acc: _GradAccumulator,
             block.create_var(gname, shape=fwd.shape, dtype=fwd.dtype,
                              lod_level=fwd.lod_level)
 
+    # In-place mutation (an output name that is also an input/closure
+    # name — While carries, assign(output=existing), in-place
+    # increments): by the time this grad op runs, env[name] holds the
+    # POST-op value, so replaying the forward from it linearizes at the
+    # wrong point (a While whose condition depends on the carry would
+    # replay ZERO iterations). Snapshot the pre-op value into the
+    # forward pass and feed the grad op the snapshot; the replay binds
+    # values positionally to the ORIGINAL names, so the rule is
+    # untouched. (Reference analog: WhileGrad's recorded step scopes,
+    # while_op.cc:96.)
+    mutated = set(fwd_out_names)
+    snap_names: Dict[str, str] = {}
+    fwd_in_value_names = []
+    for _, n in fwd_in_entries:
+        if n in mutated:
+            if n not in snap_names:
+                snap_names[n] = _snapshot_pre_value(op, block, n)
+            fwd_in_value_names.append(snap_names[n])
+        else:
+            fwd_in_value_names.append(n)
+
     gop = OpDesc(
         "__vjp__",
-        inputs={"FwdIn": [n for _, n in fwd_in_entries],
+        inputs={"FwdIn": fwd_in_value_names,
                 "OutGrad": out_grad_names},
         outputs={"InGrad": grad_outputs},
         attrs={"fwd_op": op.to_dict(),
@@ -189,6 +218,25 @@ def _generic_grad_op(op: OpDesc, block: BlockDesc, acc: _GradAccumulator,
                "closure_names": closure_names},
     )
     return gop
+
+
+_SNAP_COUNTER = [0]
+
+
+def _snapshot_pre_value(op: OpDesc, block: BlockDesc, name: str) -> str:
+    """Insert `assign(name -> snapshot)` right before `op` in the
+    forward section; returns the snapshot var name."""
+    _SNAP_COUNTER[0] += 1
+    snap = f"{name}@PRE.{_SNAP_COUNTER[0]}"
+    v = block.find_var_recursive(name)
+    block.create_var(snap,
+                     shape=(v.shape if v is not None else None),
+                     dtype=(v.dtype if v is not None else "float32"),
+                     lod_level=getattr(v, "lod_level", 0) if v else 0)
+    sop = OpDesc("assign", inputs={"X": [name]}, outputs={"Out": [snap]},
+                 attrs={})
+    block.ops.insert(block.ops.index(op), sop)
+    return snap
 
 
 def append_backward(loss, parameter_list: Optional[Sequence[str]] = None,

@@ -397,13 +397,22 @@ class While:
                 name=f"{self.helper.name}.exhausted", dtype="bool",
                 shape=[], stop_gradient=True)
             outputs["Exhausted"] = [self.exhausted.name]
+        # iteration count — and, for an unbounded loop, the handle the
+        # executor's probe-and-replay WhileGrad uses to measure a bound
+        # (core/executor.py _probe_while_bounds)
+        self.steps = self.helper.create_variable(
+            name=f"{self.helper.name}.steps", dtype="int32",
+            shape=[], stop_gradient=True)
+        outputs["Steps"] = [self.steps.name]
         self.helper.append_op(
             type="while", inputs={"Cond": self.cond_var},
             outputs=outputs,
             attrs={"sub_block_idx": blk.idx,
                    "carried_names": written,
                    "cond_name": self.cond_var.name,
-                   "max_steps": int(self.max_steps or 0)})
+                   "max_steps": int(self.max_steps or 0),
+                   "while_id": self.helper.name,
+                   "dynamic_bound": self.max_steps is None})
 
 
 class Switch:

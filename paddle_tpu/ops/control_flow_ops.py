@@ -67,6 +67,16 @@ def _while(ctx):
     carried = ctx.attr("carried_names")
     blk_idx = ctx.attr("sub_block_idx")
     max_steps = int(ctx.attr("max_steps", 0) or 0)
+    # Unbounded loop under the executor's probe-and-replay WhileGrad:
+    # the executor measured this loop's trip count with a forward probe
+    # and injects a (bucketed) static bound — the loop then lowers to
+    # the differentiable masked scan instead of lax.while_loop
+    # (reference analog: while_op.cc:96 step-scope replay).
+    if max_steps <= 0:
+        bounds = (ctx.extra or {}).get("while_bounds") or {}
+        wid = ctx.attr("while_id")
+        if wid in bounds:
+            max_steps = int(bounds[wid])
     outer = dict(ctx.env)
     cond0 = ctx.input("Cond")
     init = tuple(outer[n] for n in carried)
@@ -80,35 +90,41 @@ def _while(ctx):
 
     if max_steps > 0:
         def scan_body(state, _):
-            active, vals = state
+            active, count, vals = state
             new_cond, new_vals = body_env(vals)
             # carries may be pytrees (e.g. RaggedPair): select per leaf
             kept = tuple(
                 jax.tree_util.tree_map(
                     lambda a, b: jnp.where(active, a, b), n, o)
                 for n, o in zip(new_vals, vals))
-            return (active & new_cond, kept), None
+            count = count + active.astype(jnp.int32)
+            return (active & new_cond, count, kept), None
 
-        state0 = (cond0.reshape(()).astype(jnp.bool_), init)
-        (still_active, final_vals), _ = jax.lax.scan(
+        state0 = (cond0.reshape(()).astype(jnp.bool_),
+                  jnp.zeros((), jnp.int32), init)
+        (still_active, count, final_vals), _ = jax.lax.scan(
             scan_body, state0, None, length=max_steps)
         ctx.set_outputs("Out", list(final_vals))
         # still true after max_steps iterations => the loop was truncated
         # (silent-truncation hazard of the bounded lowering); surfaced as
         # an optional output the layer wires to `<name>.exhausted`
         ctx.set_output("Exhausted", still_active)
+        ctx.set_output("Steps", count)
         return
 
     def cond_fn(state):
         return state[0].reshape(())
 
     def body_fn(state):
-        new_cond, new_vals = body_env(state[1:])
-        return (new_cond,) + new_vals
+        new_cond, new_vals = body_env(state[2:])
+        return (new_cond, state[1] + 1) + new_vals
 
     final = jax.lax.while_loop(
-        cond_fn, body_fn, (cond0.reshape(()).astype(jnp.bool_),) + init)
-    ctx.set_outputs("Out", list(final[1:]))
+        cond_fn, body_fn,
+        (cond0.reshape(()).astype(jnp.bool_), jnp.zeros((), jnp.int32))
+        + init)
+    ctx.set_outputs("Out", list(final[2:]))
+    ctx.set_output("Steps", final[1])
 
 
 @register_op_CF("cond")

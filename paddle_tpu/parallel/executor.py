@@ -103,7 +103,8 @@ class ParallelExecutor(Executor):
         return _globalize(arr, NamedSharding(
             mesh, self.sharding.feed_spec(name, arr.ndim)))
 
-    def _compile(self, program, block, feed_sig, fetch_names, scope):
+    def _compile(self, program, block, feed_sig, fetch_names, scope,
+                 while_bounds=None):
         read_names, write_names = \
             self._state_names(program, block, scope)
         mesh = self.mesh
@@ -124,6 +125,8 @@ class ParallelExecutor(Executor):
                 "prng": lambda seed: jax.random.fold_in(
                     jax.random.PRNGKey(seed), step),
             }
+            if while_bounds:
+                extra["while_bounds"] = while_bounds
             env = trace_block(block, env, extra)
             fetches = [env[n] for n in fetch_names]
             # structure must be static (out_shardings is a pytree spec):

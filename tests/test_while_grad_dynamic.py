@@ -1,0 +1,119 @@
+"""Unbounded While gradients via the executor's probe-and-replay
+WhileGrad (core/executor.py _probe_while_bounds + the dynamic_bound
+masked-scan lowering in ops/control_flow_ops.py).
+
+Reference capability: WhileGrad runs the backward over recorded
+per-iteration step scopes for loops whose trip count is data-dependent
+and unknown at trace time (while_op.cc:96-109). TPU-native form: a
+forward probe measures the trip count, the program recompiles with the
+bucketed bound baked into a differentiable masked scan.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.layers import control_flow as cf
+
+
+def _build(lr=0.05, x0=0.3, target=2.0):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.create_parameter(
+            shape=[1], dtype="float32", name="xparam",
+            default_initializer=pt.initializer.ConstantInitializer(x0))
+        thr = layers.data("thr", [1], dtype="float32")
+        s = layers.fill_constant([1], "float32", 0.0)
+        s.stop_gradient = False   # the loop carry is on the grad path
+        cond = cf.less_than_v(s, thr)
+        w = cf.While(cond)               # NO max_steps: trip count is
+        with w.block():                  # data-dependent on the feed
+            t = layers.elementwise_add(s, x)
+            layers.assign(t, output=s)
+            cf.less_than_v(s, thr, cond=cond)
+        tgt = layers.fill_constant([1], "float32", target)
+        loss = layers.reduce_sum(layers.square(layers.elementwise_sub(
+            s, tgt)))
+        pt.optimizer.SGDOptimizer(learning_rate=lr).minimize(loss)
+    return main, startup, {"x": x, "s": s, "loss": loss, "w": w}
+
+
+def _numpy_loop(x, thr, target):
+    """Replicates the loop on the host for finite differences."""
+    s, n = 0.0, 0
+    while s < thr:
+        s += x
+        n += 1
+    return (s - target) ** 2, n
+
+
+def test_unbounded_while_gradient_matches_finite_differences():
+    lr, x0, target = 0.05, 0.3, 2.0
+    main, startup, f = _build(lr, x0, target)
+    exe = pt.Executor()
+    exe.run(startup)
+
+    thr = np.asarray([1.0], np.float32)
+    lv, steps = exe.run(main, feed={"thr": thr},
+                        fetch_list=[f["loss"], f["w"].steps])
+    # x=0.3, thr=1.0 -> s walks 0.3,0.6,0.9,1.2: four iterations
+    assert int(np.asarray(steps)) == 4
+    np.testing.assert_allclose(float(np.asarray(lv)),
+                               (1.2 - target) ** 2, rtol=1e-5)
+
+    # gradient applied by SGD == (x0 - x1)/lr; compare to central
+    # finite differences of the host replica (eps small enough not to
+    # cross a trip-count boundary)
+    x1 = float(np.asarray(pt.global_scope().get("xparam")).reshape(()))
+    g_applied = (x0 - x1) / lr
+    eps = 1e-3
+    fp, np_ = _numpy_loop(x0 + eps, 1.0, target)
+    fm, nm = _numpy_loop(x0 - eps, 1.0, target)
+    assert np_ == nm == 4
+    g_fd = (fp - fm) / (2 * eps)
+    np.testing.assert_allclose(g_applied, g_fd, rtol=1e-3)
+    # analytic: dloss/dx = 2*(s-target)*n
+    np.testing.assert_allclose(g_applied, 2 * (1.2 - target) * 4,
+                               rtol=1e-4)
+
+
+def test_unbounded_while_grad_recompiles_per_trip_count_bucket():
+    lr, x0, target = 0.0, 0.3, 2.0   # lr=0 keeps the param frozen
+    main, startup, f = _build(lr, x0, target)
+    exe = pt.Executor()
+    exe.run(startup)
+
+    # thr=1.0 -> 4 steps (bucket 4); thr=2.0 -> 7 steps (bucket 8)
+    for thr_v, n_expect in ((1.0, 4), (2.0, 7)):
+        lv, steps = exe.run(
+            main, feed={"thr": np.asarray([thr_v], np.float32)},
+            fetch_list=[f["loss"], f["w"].steps])
+        assert int(np.asarray(steps)) == n_expect, (thr_v, steps)
+        s_end = x0 * n_expect
+        np.testing.assert_allclose(float(np.asarray(lv)),
+                                   (s_end - target) ** 2, rtol=1e-4)
+    # two trip-count buckets -> two compiled variants of the program
+    uid = main.desc.uid
+    bucketed = [k for k in exe._cache if k[0] == uid]
+    assert len(bucketed) == 2
+
+
+def test_forward_only_unbounded_while_needs_no_probe():
+    # without grads the loop stays a lax.while_loop and no probe entry
+    # is created
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        i = layers.fill_constant([1], "float32", 0.0)
+        lim = layers.data("lim", [1], dtype="float32")
+        cond = cf.less_than_v(i, lim)
+        w = cf.While(cond)
+        with w.block():
+            layers.increment(i, value=1.0, in_place=True)
+            cf.less_than_v(i, lim, cond=cond)
+    exe = pt.Executor()
+    exe.run(startup)
+    iv, steps = exe.run(main, feed={"lim": np.asarray([5.0], np.float32)},
+                        fetch_list=[i, w.steps])
+    assert float(np.asarray(iv).reshape(())) == 5.0
+    assert int(np.asarray(steps)) == 5
+    assert not exe._probe_cache
