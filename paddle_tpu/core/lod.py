@@ -109,6 +109,77 @@ class RaggedNested:
         return cls(*children)
 
 
+class RaggedTree:
+    """In-graph ragged value of arbitrary nesting depth k >= 3
+    (reference: arbitrary-depth LoD, lod_tensor.h:55-107 — e.g.
+    doc -> paragraph -> sentence -> token is depth 3). Depths 1 and 2
+    keep their specialized forms (RaggedPair / RaggedNested); ops accept
+    all three.
+
+    data: [n0, m1, ..., mk, *feature_dims] (zero padded; k+1 ragged dims)
+    lengths: tuple of k int32 arrays; lengths[i] has shape
+        [n0, m1, ..., mi] and counts each level-(i+1) group's children.
+    """
+
+    __slots__ = ("data", "lengths")
+
+    def __init__(self, data, lengths):
+        self.data = data
+        self.lengths = tuple(lengths)
+
+    @property
+    def depth(self) -> int:
+        return len(self.lengths)
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def level_mask(self, i: int):
+        """[n0, m1, ..., m_{i+1}] validity of level-(i+1) slots (not
+        intersected with ancestor validity)."""
+        m = self.data.shape[i + 1]
+        pos = jnp.arange(m, dtype=jnp.int32)
+        pos = pos.reshape((1,) * (i + 1) + (m,))
+        return pos < self.lengths[i][..., None]
+
+    def mask(self):
+        """Innermost validity [n0, m1, ..., mk]: a slot is valid iff
+        every ancestor slot is."""
+        out = None
+        k = self.depth
+        for i in range(k):
+            m = self.level_mask(i)
+            m = m.reshape(m.shape + (1,) * (k - 1 - i))
+            out = m if out is None else (out & m)
+        return out
+
+    def flatten(self):
+        """Collapse the top two ragged dims: depth k -> depth k-1 over a
+        batch of n0*m1 roots (invalid slots become empty subtrees).
+        Returns a RaggedNested when the result has depth 2."""
+        n0, m1 = self.data.shape[:2]
+        valid = self.level_mask(0)                      # [n0, m1]
+        data = self.data.reshape((n0 * m1,) + self.data.shape[2:])
+        l0 = jnp.where(valid, self.lengths[1], 0).reshape(n0 * m1)
+        rest = [l.reshape((n0 * m1,) + l.shape[2:])
+                for l in self.lengths[2:]]
+        if 1 + len(rest) == 2:
+            return RaggedNested(data, l0, rest[0])
+        return RaggedTree(data, (l0,) + tuple(rest))
+
+    def tree_flatten(self):
+        return (self.data,) + self.lengths, len(self.lengths)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1:])
+
+
 def _register_pytree():
     try:
         import jax
@@ -116,6 +187,10 @@ def _register_pytree():
             RaggedPair,
             lambda rp: ((rp.data, rp.lengths), None),
             lambda aux, ch: RaggedPair(*ch))
+        jax.tree_util.register_pytree_node(
+            RaggedTree,
+            lambda rt: ((rt.data,) + rt.lengths, rt.depth),
+            lambda aux, ch: RaggedTree(ch[0], ch[1:]))
         jax.tree_util.register_pytree_node(
             RaggedNested,
             lambda rn: ((rn.data, rn.sub_lengths, rn.tok_lengths), None),
@@ -251,6 +326,97 @@ class LoDTensor:
              for j in range(int(sub_lengths[i]))]
             for i in range(data.shape[0])]
         return cls.from_nested_sequences(nested)
+
+    # ---- arbitrary-depth (k >= 1) conversions ---------------------------
+    @classmethod
+    def from_depth_sequences(cls, nested: List, depth: int,
+                             feat_shape=(), dtype=np.float32) -> "LoDTensor":
+        """Depth-k nested python lists -> LoDTensor with k LoD levels.
+        nested is lists nested `depth` deep whose leaves are token
+        arrays [len, *feat] (reference: arbitrary-depth LoD,
+        lod_tensor.h:55-107). depth=1/2 match
+        from_sequences/from_nested_sequences."""
+        lods = [[0] for _ in range(depth)]
+        leaves: List[np.ndarray] = []
+
+        def walk(node, level):
+            if level == depth - 1:
+                a = np.asarray(node)
+                leaves.append(a)
+                lods[level].append(lods[level][-1] + len(a))
+            else:
+                for child in node:
+                    walk(child, level + 1)
+                lods[level].append(lods[level][-1] + len(node))
+
+        for top in nested:
+            walk(top, 0)
+        flat = _concat_or_empty(leaves, feat_shape, dtype)
+        return cls(flat, lods)
+
+    def to_tree_padded(self, max_dims: Optional[Sequence[int]] = None):
+        """-> (data [n0, m1, ..., mk, *feat], [k lengths arrays]) — the
+        dense form RaggedTree carries in-graph. max_dims optionally pads
+        each ragged dim (m1..mk) to a fixed size (bucketing)."""
+        k = len(self.lod)
+        if k < 1:
+            raise ValueError("to_tree_padded needs at least 1 LoD level")
+        counts = [lod_to_lengths(l) for l in self.lod]
+        n0 = len(counts[0])
+        dims = []
+        for i in range(k):
+            longest = int(counts[i].max()) if len(counts[i]) else 0
+            if max_dims is not None and max_dims[i] is not None:
+                if longest > int(max_dims[i]):
+                    raise ValueError(
+                        f"LoD level {i} has a group of {longest} > "
+                        f"max_dims[{i}]={max_dims[i]}")
+                longest = int(max_dims[i])
+            dims.append(max(longest, 1))
+        feat = self.data.shape[1:]
+        data = np.zeros((n0,) + tuple(dims) + tuple(feat),
+                        dtype=self.data.dtype)
+        lengths = [np.zeros((n0,) + tuple(dims[:i]), np.int32)
+                   for i in range(k)]
+
+        # walk the offset tables: entity e at level i owns children
+        # [lod[i][e], lod[i][e+1]) at level i+1; the innermost offsets
+        # partition data rows into token runs (lod_tensor.h contract)
+        def fill_tokens(level, ent, index):
+            start, end = self.lod[level][ent], self.lod[level][ent + 1]
+            lengths[level][index] = end - start
+            if level == k - 1:
+                data[index][: end - start] = self.data[start:end]
+            else:
+                for j, child in enumerate(range(start, end)):
+                    fill_tokens(level + 1, child, index + (j,))
+
+        for e in range(n0):
+            fill_tokens(0, e, (e,))
+        return data, lengths
+
+    @classmethod
+    def from_tree_padded(cls, data: np.ndarray,
+                         lengths: Sequence[np.ndarray]) -> "LoDTensor":
+        """Inverse of to_tree_padded."""
+        k = len(lengths)
+        lods = [[0] for _ in range(k)]
+        rows: List[np.ndarray] = []
+
+        def walk(level, index):
+            n = int(lengths[level][index])
+            lods[level].append(lods[level][-1] + n)
+            if level == k - 1:
+                rows.append(data[index][:n])
+            else:
+                for j in range(n):
+                    walk(level + 1, index + (j,))
+
+        for e in range(data.shape[0]):
+            walk(0, (e,))
+        feat = data.shape[k + 1:]
+        flat = _concat_or_empty(rows, feat, data.dtype)
+        return cls(flat, lods)
 
     def __repr__(self):
         return f"LoDTensor(shape={self.data.shape}, lod={self.lod})"

@@ -98,7 +98,9 @@ def run_op(op, env: Dict[str, Any], extra: Optional[Dict] = None
     LoD propagation rule ("output lod = input lod", lod_tensor.md) maps to
     the padded TPU representation.
     """
-    from .lod import RaggedNested, RaggedPair  # local: lod has no registry dep
+    from .lod import (RaggedNested, RaggedPair,
+                      RaggedTree)  # local: lod has no registry dep
+    ragged_types = (RaggedPair, RaggedNested, RaggedTree)
 
     opdef = OpRegistry.get(op.type)
     if opdef.ragged_aware:
@@ -111,7 +113,7 @@ def run_op(op, env: Dict[str, Any], extra: Optional[Dict] = None
     needs_copy = False
     for name in op.input_names():
         v = env.get(name)
-        if isinstance(v, (RaggedPair, RaggedNested)):
+        if isinstance(v, ragged_types):
             needs_copy = True
             if ragged_src is None:
                 ragged_src = v
@@ -119,23 +121,29 @@ def run_op(op, env: Dict[str, Any], extra: Optional[Dict] = None
         local = dict(env)
         for name in op.input_names():
             v = local.get(name)
-            if isinstance(v, (RaggedPair, RaggedNested)):
+            if isinstance(v, ragged_types):
                 local[name] = v.data
     ctx = ExecutionContext(op, local, extra)
     opdef.compute(ctx)
     if ragged_src is None:
         return ctx.outputs
     # lod propagation ("output lod = input lod"): re-wrap outputs whose
-    # leading (batch, time[, sub-time]) dims match the first ragged input
-    nested = isinstance(ragged_src, RaggedNested)
-    lead = 3 if nested else 2
+    # leading (batch, time[, ...group]) dims match the first ragged input
+    if isinstance(ragged_src, RaggedTree):
+        lead = ragged_src.depth + 1
+    elif isinstance(ragged_src, RaggedNested):
+        lead = 3
+    else:
+        lead = 2
     nt = ragged_src.data.shape[:lead]
     outputs = {}
     for k, v in ctx.outputs.items():
         if hasattr(v, "ndim") and v.ndim >= lead \
                 and tuple(v.shape[:lead]) == nt \
-                and not isinstance(v, (RaggedPair, RaggedNested)):
-            if nested:
+                and not isinstance(v, ragged_types):
+            if isinstance(ragged_src, RaggedTree):
+                outputs[k] = RaggedTree(v, ragged_src.lengths)
+            elif isinstance(ragged_src, RaggedNested):
                 outputs[k] = RaggedNested(v, ragged_src.sub_lengths,
                                           ragged_src.tok_lengths)
             else:

@@ -40,7 +40,9 @@ class DataFeeder:
             lod_level = 0 if isinstance(var, str) else (var.lod_level or 0)
             dtype = "float32" if isinstance(var, str) else var.dtype
             column = [sample[i] for sample in batch]
-            if lod_level >= 2:
+            if lod_level >= 3:
+                out[name] = self._tree(name, column, dtype, var, lod_level)
+            elif lod_level == 2:
                 out[name] = self._nested(name, column, dtype, var)
             elif lod_level > 0:
                 out[name] = self._ragged(name, column, dtype, var)
@@ -112,6 +114,36 @@ class DataFeeder:
             nested, feat_shape=feat, dtype=np_dtype).to_nested_padded(
                 max_sub=pad_sub, max_tok=pad_tok)
         return RaggedNested(data, sub_l, tok_l)
+
+    def _tree(self, name, column, dtype, var, depth):
+        """lod_level>=3 var: each sample is depth-(k-1) nested lists of
+        token sequences -> RaggedTree via the depth-k LoDTensor
+        conversion (reference: arbitrary-depth LoD,
+        lod_tensor.h:55-107). Applies the flat-token reshape at leaves,
+        the max_lens cap on the token level, and pad_multiple bucketing
+        on the token dim."""
+        from .core.lod import RaggedTree
+        np_dtype = np.dtype(dtype)
+        feat = self._feat_dims(var)
+        max_tok = self.max_lens.get(name)
+
+        def conv(node, level):
+            if level == depth - 1:
+                a = self._to_step_array(node, np_dtype, feat)
+                return a if max_tok is None else a[:max_tok]
+            return [conv(c, level + 1) for c in node]
+
+        nested = [conv(sample, 0) for sample in column]
+        lt = LoDTensor.from_depth_sequences(
+            nested, depth, feat_shape=tuple(feat or ()), dtype=np_dtype)
+        # bucket the token dim so varying batch contents reuse compile
+        # signatures; group-count dims pad to the batch max
+        m = self.pad_multiple
+        tok_max = int(np.max(np.diff(lt.lod[-1]))) if len(lt.lod[-1]) > 1 \
+            else 1
+        max_dims = [None] * (depth - 1) + [((tok_max + m - 1) // m) * m]
+        data, lengths = lt.to_tree_padded(max_dims=max_dims)
+        return RaggedTree(data, tuple(lengths))
 
     def _ragged(self, name, column, dtype, var):
         np_dtype = np.dtype(dtype)

@@ -194,7 +194,7 @@ def _infer_shapes(block_desc: ir.BlockDesc, op: ir.OpDesc) -> None:
 def _infer_shapes_impl(block_desc: ir.BlockDesc, op: ir.OpDesc) -> None:
     import jax
     import jax.numpy as jnp
-    from .core.lod import RaggedNested, RaggedPair
+    from .core.lod import RaggedNested, RaggedPair, RaggedTree
     from .ops.core_ops import jnp_dtype
 
     env = {}
@@ -207,7 +207,17 @@ def _infer_shapes_impl(block_desc: ir.BlockDesc, op: ir.OpDesc) -> None:
             continue
         shape = [(_DUMMY_BATCH if d == -1 else int(d)) for d in v.shape]
         dt = jnp_dtype(v.dtype)
-        if v.lod_level >= 2:
+        if v.lod_level >= 3:
+            k = v.lod_level
+            data = jax.ShapeDtypeStruct(
+                tuple([shape[0]] + [_DUMMY_SUB] * (k - 1)
+                      + [_DUMMY_TIME] + shape[1:]), dt)
+            lengths = tuple(
+                jax.ShapeDtypeStruct(
+                    tuple([shape[0]] + [_DUMMY_SUB] * i), jnp.int32)
+                for i in range(k))
+            env[name] = RaggedTree(data, lengths)
+        elif v.lod_level == 2:
             data = jax.ShapeDtypeStruct(
                 tuple([shape[0], _DUMMY_SUB, _DUMMY_TIME] + shape[1:]), dt)
             sub_l = jax.ShapeDtypeStruct((shape[0],), jnp.int32)
@@ -235,7 +245,18 @@ def _infer_shapes_impl(block_desc: ir.BlockDesc, op: ir.OpDesc) -> None:
         v = block_desc.find_var_recursive(name)
         if v is None:
             continue
-        if isinstance(aval, RaggedNested):
+        if isinstance(aval, RaggedTree):
+            k = aval.depth
+            shape = [(-1 if d in (_DUMMY_BATCH,
+                                  _DUMMY_BATCH * _DUMMY_SUB) else int(d))
+                     for i, d in enumerate(aval.data.shape)
+                     if not (1 <= i <= k)]
+            if v.shape is None:
+                v.shape = shape
+            v.lod_level = max(v.lod_level, k)
+            if v.dtype is None:
+                v.dtype = str(aval.data.dtype)
+        elif isinstance(aval, RaggedNested):
             shape = [(-1 if d == _DUMMY_BATCH else int(d))
                      for i, d in enumerate(aval.data.shape)
                      if i not in (1, 2)]

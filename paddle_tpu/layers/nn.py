@@ -466,10 +466,12 @@ def sequence_last_step(input):
 
 
 def nested_sequence_flatten(input):
-    """Level-2 ragged (paragraph->sentence->token) -> level-1 ragged batch
-    of sub-sequences. See ops/sequence_ops.py nested_sequence_flatten."""
+    """Nested ragged -> one level shallower (level-2
+    paragraph->sentence->token becomes a level-1 batch of sub-sequences;
+    deeper LoD peels one level per call). See ops/sequence_ops.py."""
     helper = LayerHelper("nested_sequence_flatten")
-    out = helper.create_tmp_variable(input.dtype, lod_level=1)
+    out = helper.create_tmp_variable(
+        input.dtype, lod_level=max(1, (input.lod_level or 2) - 1))
     helper.append_op(type="nested_sequence_flatten", inputs={"X": input},
                      outputs={"Out": out})
     return out

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.ir import OpDesc
-from ..core.lod import RaggedNested, RaggedPair
+from ..core.lod import RaggedNested, RaggedPair, RaggedTree
 from ..core.registry import ExecutionContext, OpRegistry, register_op
 
 _JNP_DTYPE = {
@@ -268,13 +268,14 @@ def _vjp(ctx):
         res = []
         for n in grad_out_names:
             v = outs[n]
-            res.append(v.data if isinstance(v, (RaggedPair, RaggedNested))
-                       else v)
+            res.append(v.data if isinstance(
+                v, (RaggedPair, RaggedNested, RaggedTree)) else v)
         return tuple(res)
 
     _, vjp_fn = jax.vjp(f, tuple(in_vals))
-    cts = tuple(g.data if isinstance(g, (RaggedPair, RaggedNested)) else g
-                for g in out_grads)
+    cts = tuple(g.data if isinstance(
+        g, (RaggedPair, RaggedNested, RaggedTree)) else g
+        for g in out_grads)
     (in_grads,) = vjp_fn(cts)
 
     idx = 0
@@ -285,5 +286,7 @@ def _vjp(ctx):
             g = RaggedPair(g.data, v.lengths)
         elif isinstance(g, RaggedNested):
             g = RaggedNested(g.data, v.sub_lengths, v.tok_lengths)
+        elif isinstance(g, RaggedTree):
+            g = RaggedTree(g.data, v.lengths)
         ctx.set_output("InGrad", g, index=idx)
         idx += 1

@@ -16,7 +16,7 @@ import os
 import jax
 import jax.numpy as jnp
 
-from ..core.lod import RaggedNested, RaggedPair
+from ..core.lod import RaggedNested, RaggedPair, RaggedTree
 from functools import partial
 
 from ..core.registry import register_op
@@ -94,14 +94,17 @@ def _sequence_pool(ctx):
 
 @register_op_SEQ("nested_sequence_flatten")
 def _nested_sequence_flatten(ctx):
-    """2-level ragged [n, max_sub, max_tok, ...] -> level-1 ragged batch of
-    n*max_sub sub-sequences (padding slots have length 0). The inner level
-    of the reference's nested RecurrentGradientMachine loop becomes one
-    masked batch that RNN/sequence ops consume directly."""
+    """Nested ragged -> one level shallower, over a batch of n*max_sub
+    roots (padding slots have length 0). 2-level input yields a level-1
+    ragged batch the RNN/sequence ops consume directly; a depth-k
+    RaggedTree yields depth k-1 (apply repeatedly to peel an
+    arbitrary-depth LoD — lod_tensor.h:55-107). The inner level of the
+    reference's nested RecurrentGradientMachine loop becomes one masked
+    batch."""
     x = ctx.input("X")
-    if not isinstance(x, RaggedNested):
-        raise ValueError("nested_sequence_flatten needs a 2-level ragged "
-                         "input (feed a LoDTensor with two LoD levels)")
+    if not isinstance(x, (RaggedNested, RaggedTree)):
+        raise ValueError("nested_sequence_flatten needs a nested ragged "
+                         "input (feed a LoDTensor with >= 2 LoD levels)")
     ctx.set_output("Out", x.flatten())
 
 
@@ -109,21 +112,23 @@ def _nested_sequence_flatten(ctx):
 def _nested_sequence_pack(ctx):
     """Dense per-sub-sequence rows [n*max_sub, *feat] (e.g. the inner
     encoder's last states) -> level-1 ragged [n, max_sub, *feat] with the
-    outer lengths of Ref. Inverse of nested_sequence_flatten after the
-    token level is reduced away."""
+    outer lengths of Ref (2-level ragged or deeper RaggedTree). Inverse
+    of nested_sequence_flatten after the inner levels are reduced away."""
     x = ctx.input("X")
     ref = ctx.input("Ref")
-    if not isinstance(ref, RaggedNested):
-        raise ValueError("nested_sequence_pack needs a 2-level ragged Ref")
-    if isinstance(x, (RaggedPair, RaggedNested)):
+    if not isinstance(ref, (RaggedNested, RaggedTree)):
+        raise ValueError("nested_sequence_pack needs a nested ragged Ref")
+    if isinstance(x, (RaggedPair, RaggedNested, RaggedTree)):
         raise ValueError(
             "nested_sequence_pack expects DENSE per-sub-sequence rows "
-            "[n*max_sub, *feat]; got a ragged value whose token level is "
-            "still present — reduce it first (sequence_last_step / "
+            "[n*max_sub, *feat]; got a ragged value whose inner levels "
+            "are still present — reduce them first (sequence_last_step / "
             "sequence_pool)")
     n, s = ref.data.shape[:2]
+    outer = ref.sub_lengths if isinstance(ref, RaggedNested) \
+        else ref.lengths[0]
     out = x.reshape((n, s) + x.shape[1:])
-    ctx.set_output("Out", RaggedPair(out, ref.sub_lengths))
+    ctx.set_output("Out", RaggedPair(out, outer))
 
 
 @register_op_SEQ("sequence_softmax")

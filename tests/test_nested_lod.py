@@ -258,3 +258,147 @@ def test_data_feeder_nested_buckets_and_caps():
     y = f2.feed([([list(range(8))],)])["v"]   # 8 floats = 2 tokens x 4
     assert y.data.shape[3] == 4 and y.tok_lengths.max() == 2, \
         (y.data.shape, y.tok_lengths)
+
+
+# -- arbitrary-depth LoD (RaggedTree; reference lod_tensor.h:55-107) --------
+
+def _tree_fixture(rng, n=3, feat=4):
+    # doc i has i+1 paragraphs; each paragraph 1-3 sentences; each
+    # sentence 1-4 token rows of `feat` features
+    docs = []
+    for i in range(n):
+        paras = []
+        for _ in range(i + 1):
+            paras.append([rng.rand(rng.randint(1, 5), feat)
+                          .astype(np.float32)
+                          for _ in range(rng.randint(1, 4))])
+        docs.append(paras)
+    return docs
+
+
+def test_host_tree_roundtrip_depth3():
+    from paddle_tpu.core.lod import RaggedTree
+    rng = np.random.RandomState(7)
+    docs = _tree_fixture(rng)
+    t = LoDTensor.from_depth_sequences(docs, depth=3, feat_shape=(4,))
+    assert len(t.lod) == 3
+    data, lengths = t.to_tree_padded()
+    assert data.ndim == 5                       # [n, P, S, T, feat]
+    assert [l.ndim for l in lengths] == [1, 2, 3]
+    assert lengths[0].tolist() == [1, 2, 3]
+    back = LoDTensor.from_tree_padded(data, lengths)
+    assert back.lod == t.lod
+    np.testing.assert_allclose(back.data, t.data)
+
+
+def test_tree_flatten_peels_one_level():
+    import jax.numpy as jnp
+    from paddle_tpu.core.lod import RaggedTree
+    rng = np.random.RandomState(8)
+    docs = _tree_fixture(rng)
+    t = LoDTensor.from_depth_sequences(docs, depth=3, feat_shape=(4,))
+    data, lengths = t.to_tree_padded()
+    rt = RaggedTree(jnp.asarray(data), tuple(jnp.asarray(l)
+                                             for l in lengths))
+    nested = rt.flatten()
+    assert isinstance(nested, RaggedNested)
+    # flattened rows = n0*maxP paragraphs; valid ones carry their
+    # sentence counts, padding rows are empty
+    flat_subs = np.asarray(nested.sub_lengths)
+    want = []
+    maxP = data.shape[1]
+    for i, doc in enumerate(docs):
+        row = [len(p) for p in doc] + [0] * (maxP - len(doc))
+        want += row
+    assert flat_subs.tolist() == want
+
+
+def test_tree_feed_fetch_preserves_lod_depth3():
+    rng = np.random.RandomState(9)
+    docs = _tree_fixture(rng)
+    t = LoDTensor.from_depth_sequences(docs, depth=3, feat_shape=(4,))
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32", lod_level=3)
+        y = layers.scale(x, scale=3.0)
+    exe = pt.Executor()
+    exe.run(startup)
+    (out,) = exe.run(main, feed={"x": t}, fetch_list=[y])
+    assert isinstance(out, LoDTensor) and out.lod == t.lod
+    np.testing.assert_allclose(out.data, t.data * 3.0, rtol=1e-6)
+
+
+def test_three_level_hierarchical_model_trains():
+    """doc -> paragraph -> sentence -> token: peel two levels with
+    nested_sequence_flatten, encode sentences, pack back up level by
+    level, classify the doc (depth-3 RecurrentGradientMachine
+    capability)."""
+    vocab, emb, hid = 30, 8, 8
+    rng = np.random.RandomState(10)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        docs = layers.data("docs", [1], dtype="int64", lod_level=3)
+        label = layers.data("label", [1], dtype="int64")
+        paras = layers.nested_sequence_flatten(docs)   # depth 2: paras
+        sents = layers.nested_sequence_flatten(paras)  # depth 1: sents
+        e = layers.embedding(sents, size=[vocab, emb])
+        x = layers.fc(e, size=4 * hid)
+        h, _ = layers.dynamic_lstm(x, size=4 * hid)
+        sent_vec = layers.sequence_last_step(h)        # [nP*maxS, hid]
+        sent_seq = layers.nested_sequence_pack(sent_vec, paras)
+        para_vec = layers.sequence_pool(sent_seq, "sum")  # [n*maxP, hid]
+        para_seq = layers.nested_sequence_pack(para_vec, docs)
+        doc_vec = layers.sequence_pool(para_seq, "sum")   # [n, hid]
+        logits = layers.fc(doc_vec, size=2)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        pt.optimizer.AdamOptimizer(learning_rate=1e-2).minimize(loss)
+
+    def batch():
+        trees, labels = [], []
+        for i in range(4):
+            doc = []
+            for _ in range(rng.randint(1, 3)):
+                doc.append([rng.randint(1, vocab,
+                                        (rng.randint(2, 5), 1))
+                            .astype(np.int64)
+                            for _ in range(rng.randint(1, 3))])
+            trees.append(doc)
+            labels.append([i % 2])
+        return {"docs": LoDTensor.from_depth_sequences(
+                    trees, depth=3, feat_shape=(1,), dtype=np.int64),
+                "label": np.asarray(labels, np.int64)}
+
+    exe = pt.Executor()
+    exe.run(startup)
+    b = batch()
+    losses = []
+    for _ in range(12):
+        (lv,) = exe.run(main, feed=b, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_data_feeder_builds_tree_feeds():
+    from paddle_tpu.core.lod import RaggedTree
+    from paddle_tpu.data_feeder import DataFeeder
+
+    class Var:
+        name, shape, dtype, lod_level = "x", [-1, 2], "float32", 3
+
+    rng = np.random.RandomState(11)
+    feeder = DataFeeder([Var()], pad_multiple=4)
+    samples = []
+    for i in range(2):
+        doc = [[rng.rand(rng.randint(1, 4), 2).astype(np.float32)
+                for _ in range(2)]
+               for _ in range(i + 1)]
+        samples.append((doc,))
+    feed = feeder.feed(samples)
+    rt = feed["x"]
+    assert isinstance(rt, RaggedTree) and rt.depth == 3
+    assert rt.data.shape[0] == 2
+    assert rt.data.shape[3] == 4          # token dim bucketed to 4
+    assert rt.lengths[0].tolist() == [1, 2]
