@@ -9,7 +9,7 @@ from __future__ import annotations
 from ..layer_helper import LayerHelper
 
 __all__ = ["make_channel", "channel_send", "channel_recv",
-           "channel_close"]
+           "channel_close", "select"]
 
 
 def make_channel(dtype=None, capacity: int = 0):
@@ -57,3 +57,45 @@ def channel_close(channel):
     helper.append_op(type="channel_close", inputs={"Channel": channel},
                      outputs={"Status": status})
     return status
+
+
+def select(cases, timeout: float = -1.0):
+    """In-graph multi-way select (reference: select_op.cc; Go
+    semantics — pick one ready case, block until some case is ready).
+
+    cases: list of
+      ("recv", channel_var, shape, dtype) — receive one value, or
+      ("send", channel_var, value_var)    — send value_var.
+
+    Returns (case_index, recv_outs): case_index is an int32 scalar var
+    naming the fired case (branch on it with IfElse/cond/switch);
+    recv_outs holds one output var per recv case, in case order (the
+    received value when that case fired, zeros otherwise)."""
+    helper = LayerHelper("select")
+    channels, send_x, kinds = [], [], []
+    recv_shapes, recv_dtypes, recv_outs = [], [], []
+    for case in cases:
+        kind = case[0]
+        kinds.append(kind)
+        channels.append(case[1])
+        if kind == "recv":
+            _, _, shape, dtype = case
+            recv_shapes.append([int(d) for d in shape])
+            recv_dtypes.append(dtype)
+            recv_outs.append(
+                helper.create_tmp_variable(dtype, shape=list(shape)))
+        elif kind == "send":
+            send_x.append(case[2])
+        else:
+            raise ValueError(f"unknown select case kind {kind!r}")
+    idx = helper.create_tmp_variable("int32", shape=[])
+    inputs = {"Channels": channels}
+    if send_x:
+        inputs["SendX"] = send_x
+    helper.append_op(type="select", inputs=inputs,
+                     outputs={"CaseIndex": idx, "Out": recv_outs},
+                     attrs={"kinds": kinds,
+                            "timeout": float(timeout),
+                            "recv_shapes": recv_shapes,
+                            "recv_dtypes": recv_dtypes})
+    return idx, recv_outs

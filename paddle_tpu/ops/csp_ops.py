@@ -180,3 +180,103 @@ def _channel_close(ctx):
         _host_close, jax.ShapeDtypeStruct((), jnp.int32), cid,
         ordered=True)
     ctx.set_output("Status", status)
+
+
+def _host_select(cids, *send_vals, kinds, timeout, recv_specs):
+    """Host arbitration for the in-graph select (reference:
+    select_op.cc — pick one ready case, Go semantics). Blocks until a
+    case fires (timeout < 0) or raises TimeoutError. Returns the fired
+    case index plus one buffer per recv case (zeros for cases that did
+    not fire)."""
+    import time as _time
+    from ..concurrency import select as host_select
+
+    cids = np.asarray(cids).reshape(-1)
+    send_vals = list(send_vals)
+    recv_out = [np.zeros(shape, dtype) for shape, dtype in recv_specs]
+    recv_slot = {}   # case index -> recv buffer position
+    for i, kind in enumerate(kinds):
+        if kind == "recv":
+            recv_slot[i] = len(recv_slot)
+    fired_value = {}
+    si = 0
+
+    def make_recv_cb(i):
+        def cb(v, ok):
+            if ok:
+                fired_value[i] = np.asarray(v)
+        return cb
+
+    cases = []
+    for i, kind in enumerate(kinds):
+        ch = get_channel(int(cids[i]))
+        if kind == "recv":
+            cases.append(("recv", ch, make_recv_cb(i)))
+        else:
+            cases.append(("send", ch, (np.asarray(send_vals[si]), None)))
+            si += 1
+
+    t = float(timeout)
+    if t < 0:
+        idx = host_select(cases)          # block until one case fires
+    else:
+        deadline = _time.monotonic() + t
+        sentinel = []
+        while True:
+            idx = host_select(cases, default=lambda: sentinel.append(1))
+            if idx >= 0:
+                break
+            if _time.monotonic() >= deadline:
+                raise TimeoutError(f"select timed out after {t}s")
+            _time.sleep(0.001)
+
+    if idx in recv_slot and idx in fired_value:
+        buf = fired_value[idx]
+        slot = recv_slot[idx]
+        want = recv_out[slot]
+        recv_out[slot] = buf.astype(want.dtype, copy=False).reshape(
+            want.shape)
+    return (np.int32(idx),) + tuple(recv_out)
+
+
+@register_op("select", stateful=True,
+             no_grad_slots=["Channels", "SendX"])
+def _select(ctx):
+    """In-graph multi-way select over channels (reference:
+    select_op.cc — graph-level select with one sub-scope per case; Go
+    semantics: pick a ready case at random, block until one is). Host
+    arbitration rides the same ordered io_callback bridge as
+    channel_send/recv, so a select's choice keeps program order with
+    surrounding channel ops and interoperates with host go() threads.
+
+    Outputs: CaseIndex (int32 scalar — downstream control flow branches
+    on it with IfElse/cond/switch) and one Out per recv case (the
+    received value when that case fired, zeros otherwise)."""
+    cids = ctx.inputs("Channels")
+    send_vals = ctx.inputs("SendX") or []
+    kinds = list(ctx.attr("kinds"))
+    timeout = float(ctx.attr("timeout", -1.0))
+    recv_shapes = ctx.attr("recv_shapes", []) or []
+    recv_dtypes = ctx.attr("recv_dtypes", []) or []
+    recv_specs = [(tuple(int(d) for d in s), np.dtype(dt).name)
+                  for s, dt in zip(recv_shapes, recv_dtypes)]
+    for shape, _ in recv_specs:
+        if any(d < 0 for d in shape):
+            raise ValueError(
+                f"select recv cases need fully static shapes, got "
+                f"{shape}")
+    if len(kinds) != len(cids):
+        raise ValueError(f"select got {len(cids)} channels for "
+                         f"{len(kinds)} case kinds")
+
+    out_shapes = (jax.ShapeDtypeStruct((), jnp.int32),) + tuple(
+        jax.ShapeDtypeStruct(shape, jnp_dtype(dt))
+        for shape, dt in recv_specs)
+    cid_vec = jnp.stack([jnp.asarray(c, jnp.int32).reshape(())
+                         for c in cids])
+    res = jax.experimental.io_callback(
+        functools.partial(_host_select, kinds=tuple(kinds),
+                          timeout=timeout, recv_specs=tuple(recv_specs)),
+        out_shapes, cid_vec, *send_vals, ordered=True)
+    ctx.set_output("CaseIndex", res[0])
+    ctx.set_outputs("Out", list(res[1:]))

@@ -223,3 +223,109 @@ def test_ingraph_channel_bridges_host_go_producer():
     import pytest
     with pytest.raises(Exception, match="closed"):
         exe.run(main, fetch_list=[out])
+
+
+# -- in-graph select (ops/csp_ops.py select; reference select_op.cc) --------
+
+def test_ingraph_select_picks_ready_channel_and_branches():
+    """Program control flow branches on which channel select fired:
+    only ch2 has a value, so case 1 fires, its value is received, and
+    the cond branch keyed on the case index takes the ch2 path."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.layers import control_flow as cf
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ch1 = layers.make_channel(capacity=2)
+        ch2 = layers.make_channel(capacity=2)
+        v = layers.fill_constant([2], "float32", 7.0)
+        layers.channel_send(ch2, v)
+        idx, (r1, r2) = layers.select([
+            ("recv", ch1, [2], "float32"),
+            ("recv", ch2, [2], "float32"),
+        ])
+        fired_second = layers.cast(idx, "float32")  # 0.0 or 1.0
+        pred = cf.less_than_v(layers.fill_constant([], "float32", 0.5),
+                              fired_second)
+        out = cf.cond_op(
+            pred,
+            lambda: layers.scale(r2, scale=10.0),   # ch2 path
+            lambda: layers.scale(r1, scale=-1.0))   # ch1 path
+        layers.channel_close(ch1)
+        layers.channel_close(ch2)
+    exe = pt.Executor()
+    exe.run(startup)
+    iv, r2v, ov = exe.run(main, fetch_list=[idx, r2, out])
+    assert int(np.asarray(iv)) == 1
+    np.testing.assert_allclose(np.asarray(r2v), 7.0)
+    np.testing.assert_allclose(np.asarray(ov), 70.0)
+
+
+def test_ingraph_select_send_case():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ch = layers.make_channel(capacity=1)
+        v = layers.fill_constant([3], "float32", 2.5)
+        idx, _ = layers.select([("send", ch, v)])
+        got = layers.channel_recv(ch, shape=[3], dtype="float32")
+        layers.channel_close(ch)
+    exe = pt.Executor()
+    exe.run(startup)
+    iv, gv = exe.run(main, fetch_list=[idx, got])
+    assert int(np.asarray(iv)) == 0
+    np.testing.assert_allclose(np.asarray(gv), 2.5)
+
+
+def test_ingraph_select_blocks_for_host_producer():
+    """select blocks until a host-side go() thread feeds one of the
+    channels — the go_op + select_op interop pattern."""
+    import time
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.concurrency import Channel, go
+    from paddle_tpu.ops.csp_ops import register_channel
+
+    host_ch = Channel(capacity=1)
+    cid = register_channel(host_ch)
+    other = Channel(capacity=1)
+    cid2 = register_channel(other)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        c1 = layers.fill_constant([], "int32", cid)
+        c2 = layers.fill_constant([], "int32", cid2)
+        idx, (ra, rb) = layers.select([
+            ("recv", c1, [1], "float32"),
+            ("recv", c2, [1], "float32"),
+        ])
+    exe = pt.Executor()
+    exe.run(startup)
+
+    def produce():
+        time.sleep(0.2)
+        host_ch.send(np.asarray([42.0], np.float32))
+
+    go(produce)
+    iv, rav = exe.run(main, fetch_list=[idx, ra])[0:2]
+    assert int(np.asarray(iv)) == 0
+    np.testing.assert_allclose(np.asarray(rav), 42.0)
+
+
+def test_ingraph_select_timeout():
+    import pytest
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ch = layers.make_channel(capacity=1)
+        idx, _ = layers.select([("recv", ch, [1], "float32")],
+                               timeout=0.2)
+    exe = pt.Executor()
+    exe.run(startup)
+    with pytest.raises(Exception, match="[Tt]imed out"):
+        exe.run(main, fetch_list=[idx])
