@@ -59,7 +59,14 @@ FLAGS: Dict[str, tuple] = {
     "BENCH_N1": ("5", "bench.py", "short marginal-timing run"),
     "BENCH_N2": ("25", "bench.py", "long marginal-timing run"),
     "BENCH_EXTRAS": ("1", "bench.py", "run the LSTM-LM extra metric"),
-    "BENCH_TRANSFORMER": ("0", "bench.py",
+    "BENCH_REAL_INPUT": ("1", "bench.py",
+                         "measure end-to-end throughput with the real "
+                         "input pipeline (recordio loader -> device "
+                         "prefetch) in the timed loop"),
+    "BENCH_DATA_DIR": ("/tmp/pt_bench_imagenet", "bench.py",
+                       "synthetic recordio shard directory for the "
+                       "real-input bench"),
+    "BENCH_TRANSFORMER": ("1", "bench.py",
                           "run the transformer extra metric"),
 }
 

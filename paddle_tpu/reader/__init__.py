@@ -230,3 +230,26 @@ def double_buffer(reader, size: int = 2):
     """Prefetch decorated batches on a background thread so host input
     assembly overlaps device compute."""
     return buffered(reader, size)
+
+
+def device_prefetch(reader, size: int = 2):
+    """Device double-buffering (reference:
+    operators/reader/create_double_buffer_reader_op.cc): a background
+    thread pushes upcoming batches to the accelerator with
+    jax.device_put while the current step computes, so the host->device
+    transfer overlaps device time instead of serializing with it.
+    Batch samples may be arrays or (nested) tuples/lists/dicts of
+    arrays; non-array leaves pass through."""
+    import jax
+
+    def to_device(sample):
+        if isinstance(sample, (tuple, list)):
+            return type(sample)(to_device(s) for s in sample)
+        if isinstance(sample, dict):
+            return {k: to_device(v) for k, v in sample.items()}
+        if hasattr(sample, "shape") and hasattr(sample, "dtype"):
+            return jax.device_put(sample)
+        return sample
+
+    inner = buffered(map_readers(to_device, reader), size)
+    return inner
