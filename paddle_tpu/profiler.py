@@ -86,3 +86,115 @@ def device_profiler(logdir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+def _parse_device_trace(logdir: str) -> List[Dict]:
+    """Newest chrome trace under an xprof logdir -> flat event list
+    (only complete 'X' events, annotated with their process name)."""
+    import glob
+    import gzip
+    import os
+
+    candidates = sorted(
+        glob.glob(os.path.join(logdir, "plugins", "profile", "*",
+                               "*.trace.json.gz")),
+        key=os.path.getmtime)
+    if not candidates:
+        return []
+    with gzip.open(candidates[-1], "rt") as f:
+        tr = json.load(f)
+    raw = tr.get("traceEvents", [])
+    pid_names = {e["pid"]: e["args"].get("name", "")
+                 for e in raw
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+    out = []
+    for e in raw:
+        if e.get("ph") != "X":
+            continue
+        out.append({"name": e.get("name", ""), "ts": e.get("ts", 0),
+                    "dur": e.get("dur", 0), "ph": "X",
+                    "pid": e.get("pid", 0), "tid": e.get("tid", 0),
+                    "proc": pid_names.get(e.get("pid"), "")})
+    return out
+
+
+class MergedProfile:
+    """One sorted per-op table + one timeline combining host
+    RecordEvents with device (xprof) activity — the TPU-native analog
+    of the reference's merged profiler output
+    (platform/device_tracer.cc:40-74 + profiler.h:153-158, which fold
+    CUPTI device records into the CPU event table)."""
+
+    def __init__(self):
+        self.host_events: List[Dict] = []
+        self.device_events: List[Dict] = []
+
+    def table(self, limit: Optional[int] = None) -> List[Dict]:
+        agg: Dict = {}
+        for e in self.host_events:
+            a = agg.setdefault(("host", e["name"]),
+                               {"calls": 0, "total_us": 0.0})
+            a["calls"] += 1
+            a["total_us"] += e["dur"]
+        for e in self.device_events:
+            if "device" not in e.get("proc", "").lower() \
+                    and "tpu" not in e.get("proc", "").lower():
+                continue
+            a = agg.setdefault(("device", e["name"]),
+                               {"calls": 0, "total_us": 0.0})
+            a["calls"] += 1
+            a["total_us"] += e["dur"]
+        rows = [{"place": k[0], "name": k[1], **v} for k, v in agg.items()]
+        rows.sort(key=lambda r: -r["total_us"])
+        return rows[:limit] if limit else rows
+
+    def export_chrome_trace(self, path: str):
+        """Host and device events in ONE timeline (host pid 0; device
+        events keep their trace pids, offset to avoid collision)."""
+        events = list(self.host_events)
+        for e in self.device_events:
+            d = dict(e)
+            d.pop("proc", None)
+            d["pid"] = 1000 + int(d.get("pid", 0))
+            events.append(d)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+
+    def __str__(self):
+        lines = [f"{'place':8s} {'total ms':>10s} {'calls':>7s}  name"]
+        for r in self.table(limit=40):
+            lines.append(f"{r['place']:8s} {r['total_us'] / 1e3:10.3f} "
+                         f"{r['calls']:7d}  {r['name'][:70]}")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def merged_profile(logdir: str = "/tmp/paddle_tpu_xprof"):
+    """Capture host RecordEvents AND a device trace in one scope; yields
+    a MergedProfile filled on exit.
+
+        with profiler.merged_profile() as prof:
+            train_steps()
+        print(prof)                      # one sorted host+device table
+        prof.export_chrome_trace("t.json")   # one merged timeline
+    """
+    import jax
+
+    global _enabled
+    prof = MergedProfile()
+    prev_events = list(_events)
+    _events.clear()
+    _enabled = True
+    jax.profiler.start_trace(logdir)
+    try:
+        yield prof
+    finally:
+        jax.profiler.stop_trace()
+        _enabled = False
+        prof.host_events = list(_events)
+        _events.clear()
+        _events.extend(prev_events)
+        try:
+            prof.device_events = _parse_device_trace(logdir)
+        except Exception:
+            prof.device_events = []
