@@ -37,10 +37,13 @@ BASELINE_IMAGES_PER_SEC = 81.69
 BASELINE_LSTM_TOKENS_PER_SEC = 64 * 100 / 0.184
 
 # MFU accounting (north star: >=50% MFU ResNet-50): v5e peak bf16
-# throughput per chip, and ResNet-50 training FLOPs per image
-# (~4.1 GFLOP forward at 224^2 x 3 for fwd+bwd).
+# throughput per chip. ResNet-50 forward is ~4.1 GMAC/image at 224^2;
+# the MFU convention (and XLA's flop counter) counts 2 FLOPs per MAC,
+# and training ~3 forward-equivalent passes. Cross-checked against
+# XLA cost analysis of the compiled train step: 3.086e12 flops at
+# bs128 = 24.1 GFLOP/image (MFU_BREAKDOWN.md).
 V5E_PEAK_FLOPS = 197e12
-RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.1e9
+RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 2 * 4.1e9
 # transformer-base MFU via the 6*N*D rule (N ~= 98M params incl.
 # embeddings for the bench config: 6 enc + 6 dec layers, d512, 32k vocab)
 TRANSFORMER_FLOPS_PER_TOKEN = 6 * 98e6
@@ -50,13 +53,21 @@ WARMUP = int(os.environ.get("BENCH_WARMUP", "3"))
 N1 = int(os.environ.get("BENCH_N1", "5"))
 N2 = int(os.environ.get("BENCH_N2", "25"))
 RUN_EXTRAS = os.environ.get("BENCH_EXTRAS", "1") == "1"
+# headline metric repeats (median + spread); extras stay single-shot
+REPEATS = int(os.environ.get("BENCH_REPEATS", "2"))
 
 
 def _marginal_steps_per_sec(exe, program, feed, loss_var, n1=None,
-                            n2=None):
-    """Marginal steps/sec via two synced runs of different lengths."""
+                            n2=None, repeats=None):
+    """Marginal steps/sec via two synced runs of different lengths.
+
+    With repeats > 1, the (n1, n2) pair is measured that many times and
+    the MEDIAN estimate is returned along with the relative spread
+    (max-min over median) — the repeat-and-report-spread convention
+    that makes regressions smaller than tunnel noise visible."""
     n1 = n1 or N1
     n2 = n2 or N2
+    repeats = repeats if repeats is not None else REPEATS
 
     def timed(n):
         t0 = time.perf_counter()
@@ -73,13 +84,18 @@ def _marginal_steps_per_sec(exe, program, feed, loss_var, n1=None,
         exe.run(program, feed=feed, fetch_list=[loss_var],
                 return_numpy=False)
     timed(1)     # synced throwaway: drains warmups + any lazy compiles
-    t1 = timed(n1)
-    t2 = timed(n2)
-    if t2 <= t1:
-        raise RuntimeError(
-            f"marginal timing invalid: t({n2})={t2:.3f}s <= "
-            f"t({n1})={t1:.3f}s — timing not steady-state")
-    return (n2 - n1) / (t2 - t1)
+    ests = []
+    for _ in range(max(1, repeats)):
+        t1 = timed(n1)
+        t2 = timed(n2)
+        if t2 <= t1:
+            raise RuntimeError(
+                f"marginal timing invalid: t({n2})={t2:.3f}s <= "
+                f"t({n1})={t1:.3f}s — timing not steady-state")
+        ests.append((n2 - n1) / (t2 - t1))
+    med = float(np.median(ests))
+    spread = (max(ests) - min(ests)) / med if len(ests) > 1 else 0.0
+    return med, spread
 
 
 def bench_resnet(pt):
@@ -96,8 +112,8 @@ def bench_resnet(pt):
     img.flags.writeable = False
     label.flags.writeable = False
     feed = {"img": img, "label": label}
-    sps = _marginal_steps_per_sec(exe, main_p, feed, f["loss"])
-    return BATCH * sps
+    sps, spread = _marginal_steps_per_sec(exe, main_p, feed, f["loss"])
+    return BATCH * sps, spread
 
 
 def _ensure_bench_shards(n_images=512, shards=4):
@@ -178,6 +194,17 @@ def bench_resnet_real_input(pt):
                                       BATCH, drop_last=True))
     stream = iter(rd.device_prefetch(batched, size=2)())
 
+    # host input pipeline standalone: loader -> decode -> collate (no
+    # device leg — through the tunnel, transfer timing is only
+    # meaningful in a clean session; the isolated measurement lives in
+    # MFU_BREAKDOWN.md). This is the host side's capability number.
+    host_stream = iter(batched())
+    next(host_stream)
+    t0 = time.perf_counter()
+    for _ in range(8):
+        next(host_stream)
+    pipeline_ips = BATCH * 8 / (time.perf_counter() - t0)
+
     def run_n(n):
         t0 = time.perf_counter()
         lv = None
@@ -191,16 +218,23 @@ def bench_resnet_real_input(pt):
             raise RuntimeError("non-finite loss in real-input bench")
         return time.perf_counter() - t0
 
-    for _ in range(WARMUP):
+    # end-to-end (short windows: through the axon tunnel each step that
+    # carries a NOVEL argument buffer pays a flat ~1-2s tunnel
+    # round-trip penalty regardless of size or residency — measured in
+    # MFU_BREAKDOWN.md — so the end-to-end number reflects the tunnel,
+    # not the input design; on a directly attached host the pipeline
+    # number above is the binding constraint)
+    for _ in range(2):
         imgs, labels = next(stream)
         exe.run(main_p, feed={"img_u8": imgs, "label": labels},
                 fetch_list=[loss], return_numpy=False)
     run_n(1)
-    t1 = run_n(N1)
-    t2 = run_n(N2)
+    t1 = run_n(2)
+    t2 = run_n(6)
     if t2 <= t1:
         raise RuntimeError("real-input marginal timing not steady-state")
-    return BATCH * (N2 - N1) / (t2 - t1)
+    e2e_ips = BATCH * (6 - 2) / (t2 - t1)
+    return e2e_ips, pipeline_ips
 
 
 def bench_transformer(pt):
@@ -224,7 +258,7 @@ def bench_transformer(pt):
     }
     for v in feed.values():
         v.flags.writeable = False
-    sps = _marginal_steps_per_sec(exe, main_p, feed, f["loss"])
+    sps, _ = _marginal_steps_per_sec(exe, main_p, feed, f["loss"])
     return b * ln * sps
 
 
@@ -245,8 +279,8 @@ def bench_lstm_lm(pt):
             "targets": RaggedPair(ids, lens)}
     # LSTM steps are ~ms-scale: use longer runs so the marginal delta
     # dwarfs tunnel jitter
-    sps = _marginal_steps_per_sec(exe, main_p, feed, f["loss"],
-                                  n1=20, n2=120)
+    sps, _ = _marginal_steps_per_sec(exe, main_p, feed, f["loss"],
+                                     n1=20, n2=120, repeats=1)
     return b * t * sps
 
 
@@ -258,18 +292,23 @@ def main():
     amp_on = os.environ.get("PADDLE_TPU_AMP", "1") == "1"
     pt.amp.enable(amp_on)
 
-    images_per_sec = bench_resnet(pt)
+    images_per_sec, resnet_spread = bench_resnet(pt)
 
     extras = {}
     if os.environ.get("BENCH_REAL_INPUT", "1") == "1":
         try:
             pt.reset_default_programs()
             pt.reset_global_scope()
-            real_ips = bench_resnet_real_input(pt)
+            real_ips, pipeline_ips = bench_resnet_real_input(pt)
             extras["resnet50_real_input_images_per_sec"] = round(
                 real_ips, 2)
-            extras["real_input_vs_cached"] = round(
-                real_ips / images_per_sec, 3)
+            extras["host_input_pipeline_images_per_sec"] = round(
+                pipeline_ips, 2)
+            # can the host pipeline keep the chip fed? (>1 means yes;
+            # the tunnel's flat per-novel-arg execute penalty caps the
+            # end-to-end number on this link — see MFU_BREAKDOWN.md)
+            extras["host_pipeline_vs_compute"] = round(
+                pipeline_ips / images_per_sec, 3)
         except Exception as e:
             extras["real_input_error"] = repr(e)[:200]
     if RUN_EXTRAS:
@@ -297,6 +336,7 @@ def main():
                 3)
         except Exception as e:
             extras["transformer_error"] = repr(e)[:200]
+    extras["resnet_spread_pct"] = round(100 * resnet_spread, 1)
     extras["resnet_mfu_est"] = round(
         images_per_sec * RESNET50_TRAIN_FLOPS_PER_IMAGE / V5E_PEAK_FLOPS,
         3)
