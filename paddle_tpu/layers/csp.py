@@ -9,7 +9,7 @@ from __future__ import annotations
 from ..layer_helper import LayerHelper
 
 __all__ = ["make_channel", "channel_send", "channel_recv",
-           "channel_close", "select"]
+           "channel_close", "select", "Go"]
 
 
 def make_channel(dtype=None, capacity: int = 0):
@@ -57,6 +57,62 @@ def channel_close(channel):
     helper.append_op(type="channel_close", inputs={"Channel": channel},
                      outputs={"Status": status})
     return status
+
+
+class Go:
+    """In-graph go block (reference: go_op.cc + fluid.concurrency Go):
+    ops built inside `with Go().block():` form a sub-block that a host
+    thread executes (eagerly) when the program reaches the go op —
+    fire-and-forget, typically feeding/draining channels the main
+    program shares.
+
+        g = Go()
+        with g.block():
+            layers.channel_send(ch, v)   # runs on the spawned thread
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("go", name=name)
+        self._block = None
+
+    def block(self):
+        return Go._Guard(self)
+
+    class _Guard:
+        def __init__(self, g):
+            self.g = g
+
+        def __enter__(self):
+            from ..framework import default_main_program
+            prog = default_main_program()
+            self.g._prog = prog
+            self.g._block = prog.create_block()
+            return self.g
+
+        def __exit__(self, *exc):
+            prog = self.g._prog
+            prog.rollback()
+            self.g._finalize()
+            return False
+
+    def _finalize(self):
+        blk = self._block
+        parent = self._prog.block(blk.desc.parent_idx)
+        # captured inputs: names the body reads that it did not produce
+        # and that exist in the parent scope chain
+        produced, captured = set(), []
+        for op in blk.desc.ops:
+            for n in op.input_names():
+                if n not in produced and n not in captured and \
+                        parent.desc.find_var_recursive(n) is not None:
+                    captured.append(n)
+            produced.update(op.output_names())
+        self.status = self.helper.create_tmp_variable("int32", shape=[])
+        self.helper.append_op(
+            type="go", inputs={"X": captured},
+            outputs={"Status": self.status},
+            attrs={"sub_block_idx": blk.idx,
+                   "captured_names": captured})
 
 
 def select(cases, timeout: float = -1.0):

@@ -311,3 +311,43 @@ def _pipeline(ctx):
             a = stage_fn({n: p[i] for n, p in zip(names, params)}, a)
         out = a
     ctx.set_output("Out", out)
+
+
+@register_op_CF("go", stateful=True)
+def _go(ctx):
+    """In-graph go: launch the sub-block on a host thread when this op
+    executes (reference: go_op.cc:29 — ExecuteOnThread of the sub-block
+    against a child scope). Captured inputs are snapshotted through an
+    ordered io_callback at the op's program position, then the body ops
+    run EAGERLY (concrete jax values) on the spawned thread — so its
+    channel ops interoperate with the program's own io_callback channel
+    sends/recvs and with host concurrency.Channel users. Fire and
+    forget: no outputs flow back (as in the reference)."""
+    from ..concurrency import go as host_go
+    from ..core.registry import run_op
+
+    blk_idx = ctx.attr("sub_block_idx")
+    captured = list(ctx.attr("captured_names", []) or [])
+    vals = ctx.inputs("X") or []
+    prog = ctx.extra["program"]
+    block = prog.blocks[blk_idx]
+
+    def _host_launch(*snap):
+        import numpy as _np
+
+        def body():
+            env = {n: _np.asarray(v) for n, v in zip(captured, snap)}
+            extra = {
+                "program": prog,
+                "step": jnp.zeros((), jnp.int32),
+                "prng": lambda seed: jax.random.PRNGKey(seed),
+            }
+            for op in block.ops:
+                env.update(run_op(op, env, extra))
+        host_go(body)
+        return _np.int32(1)
+
+    status = jax.experimental.io_callback(
+        _host_launch, jax.ShapeDtypeStruct((), jnp.int32), *vals,
+        ordered=True)
+    ctx.set_output("Status", status)

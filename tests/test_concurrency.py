@@ -329,3 +329,55 @@ def test_ingraph_select_timeout():
     exe.run(startup)
     with pytest.raises(Exception, match="[Tt]imed out"):
         exe.run(main, fetch_list=[idx])
+
+
+# -- in-graph go (ops/control_flow_ops.py go; reference go_op.cc) -----------
+
+def test_ingraph_go_produces_for_program_recv():
+    """A go block spawned BY THE PROGRAM feeds a channel the same
+    program then receives from — the reference's go_op + channel
+    pattern, fully in-graph."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.layers.csp import Go
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ch = layers.make_channel(capacity=2)
+        base = layers.fill_constant([2], "float32", 5.0)
+        g = Go()
+        with g.block():
+            doubled = layers.scale(base, scale=2.0)  # runs on go thread
+            layers.channel_send(ch, doubled)
+        got = layers.channel_recv(ch, shape=[2], dtype="float32",
+                                  timeout=10.0)
+        out = layers.scale(got, scale=3.0)
+        layers.channel_close(ch)
+    exe = pt.Executor()
+    exe.run(startup)
+    (ov,) = exe.run(main, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(ov), 30.0)
+
+
+def test_ingraph_go_multiple_sends_fifo():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.layers.csp import Go
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ch = layers.make_channel(capacity=4)
+        a = layers.fill_constant([1], "float32", 1.0)
+        g = Go()
+        with g.block():
+            layers.channel_send(ch, a)
+            layers.channel_send(ch, layers.scale(a, scale=2.0))
+        r1 = layers.channel_recv(ch, shape=[1], dtype="float32",
+                                 timeout=10.0)
+        r2 = layers.channel_recv(ch, shape=[1], dtype="float32",
+                                 timeout=10.0)
+        layers.channel_close(ch)
+    exe = pt.Executor()
+    exe.run(startup)
+    v1, v2 = exe.run(main, fetch_list=[r1, r2])
+    assert float(np.asarray(v1)) == 1.0 and float(np.asarray(v2)) == 2.0
