@@ -68,6 +68,9 @@ FLAGS: Dict[str, tuple] = {
                        "real-input bench"),
     "BENCH_TRANSFORMER": ("1", "bench.py",
                           "run the transformer extra metric"),
+    "BENCH_REPEATS": ("2", "bench.py",
+                      "repeat the headline marginal measurement and "
+                      "report median + spread"),
 }
 
 

@@ -49,8 +49,6 @@ REPLACED = {
     "get_places": "jax.devices()/mesh",
     # go/select orchestration stays host-side (channel ops are now
     # registered in-graph via io_callback, ops/csp_ops.py)
-    "go": "concurrency.go",
-    "select": "concurrency.select",
     # readers are host-side pipeline + native loader
     "create_batch_reader": "reader.batch decorator",
     "create_double_buffer_reader": "executor device-side feed cache",
