@@ -68,6 +68,11 @@ FLAGS: Dict[str, tuple] = {
                        "real-input bench"),
     "BENCH_TRANSFORMER": ("1", "bench.py",
                           "run the transformer extra metric"),
+    "PADDLE_TPU_FUSED_XENT": (
+        "0", "ops/nn_ops.py",
+        "opt-in streaming softmax-cross-entropy (custom vjp, no "
+        "full-vocab f32 buffer) for very large vocabularies; measured "
+        "15% slower than the autodiff path at 32k vocab on v5e"),
     "BENCH_REPEATS": ("2", "bench.py",
                       "repeat the headline marginal measurement and "
                       "report median + spread"),
