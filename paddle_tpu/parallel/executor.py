@@ -18,7 +18,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.executor import Executor, CompiledProgram, trace_block
-from ..core.lod import RaggedNested, RaggedPair
+from ..core.lod import RaggedNested, RaggedPair, RaggedTree
 from ..core.scope import Scope, global_scope
 from .mesh import get_mesh, make_mesh
 
@@ -99,6 +99,13 @@ class ParallelExecutor(Executor):
                     mesh, self.sharding.feed_spec(name, 1))),
                 _globalize(v.tok_lengths, NamedSharding(
                     mesh, self.sharding.feed_spec(name, 2))))
+        if isinstance(v, RaggedTree):
+            return RaggedTree(
+                _globalize(v.data, NamedSharding(
+                    mesh, self.sharding.feed_spec(name, v.data.ndim))),
+                tuple(_globalize(l, NamedSharding(
+                    mesh, self.sharding.feed_spec(name, i + 1)))
+                    for i, l in enumerate(v.lengths)))
         arr = np.asarray(v)
         return _globalize(arr, NamedSharding(
             mesh, self.sharding.feed_spec(name, arr.ndim)))
@@ -156,6 +163,14 @@ class ParallelExecutor(Executor):
                     NamedSharding(mesh, self.sharding.feed_spec(name, ndim)),
                     NamedSharding(mesh, self.sharding.feed_spec(name, 1)),
                     NamedSharding(mesh, self.sharding.feed_spec(name, 2)))
+            elif sig[0] == "raggedk":
+                depth, shape = sig[1], sig[2]
+                feed_shardings[name] = RaggedTree(
+                    NamedSharding(mesh,
+                                  self.sharding.feed_spec(name, len(shape))),
+                    tuple(NamedSharding(mesh,
+                                        self.sharding.feed_spec(name, i + 1))
+                          for i in range(depth)))
             else:
                 ndim = len(sig[0])
                 feed_shardings[name] = NamedSharding(
