@@ -262,6 +262,69 @@ def bench_transformer(pt):
     return b * ln * sps
 
 
+def bench_vgg(pt):
+    """VGG-16 ImageNet-shape training (BASELINE config 2's second
+    model; benchmark/fluid vgg.py)."""
+    from paddle_tpu.models import vgg
+    b = 64
+    main_p, startup, f = vgg.build_train(class_dim=1000,
+                                         image_shape=(3, 224, 224),
+                                         lr=0.01)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    img = rng.rand(b, 3, 224, 224).astype(np.float32)
+    label = rng.randint(0, 1000, (b, 1)).astype(np.int32)
+    img.flags.writeable = False
+    label.flags.writeable = False
+    feed = {"img": img, "label": label}
+    sps, _ = _marginal_steps_per_sec(exe, main_p, feed, f["loss"],
+                                     repeats=1)
+    return b * sps
+
+
+def bench_mnist(pt):
+    """MNIST conv training (BASELINE config 1; tests/book
+    recognize_digits)."""
+    from paddle_tpu.models import mnist
+    b = 512
+    main_p, startup, f = mnist.build_train()
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    img = rng.rand(b, 1, 28, 28).astype(np.float32)
+    label = rng.randint(0, 10, (b, 1)).astype(np.int32)
+    img.flags.writeable = False
+    label.flags.writeable = False
+    feed = {"img": img, "label": label}
+    sps, _ = _marginal_steps_per_sec(exe, main_p, feed, f["loss"],
+                                     n1=20, n2=120, repeats=1)
+    return b * sps
+
+
+def bench_deepfm(pt):
+    """DeepFM CTR with wide sparse embeddings (BASELINE config 5 —
+    the high-dim sparse-gradient regime)."""
+    from paddle_tpu.models import deepfm
+    b, fields = 2048, 39
+    main_p, startup, f = deepfm.build_train(num_features=int(1e5),
+                                            num_fields=fields)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {
+        "feat_ids": rng.randint(0, int(1e5), (b, fields, 1)).astype(
+            np.int64),
+        "feat_vals": rng.rand(b, fields).astype(np.float32),
+        "label": rng.randint(0, 2, (b, 1)).astype(np.float32),
+    }
+    for v in feed.values():
+        v.flags.writeable = False
+    sps, _ = _marginal_steps_per_sec(exe, main_p, feed, f["loss"],
+                                     n1=20, n2=120, repeats=1)
+    return b * sps
+
+
 def bench_lstm_lm(pt):
     from paddle_tpu.models import lstm_lm
     from paddle_tpu.core.lod import RaggedPair
@@ -324,6 +387,19 @@ def main():
                 tok_s / BASELINE_LSTM_TOKENS_PER_SEC, 2)
         except Exception as e:  # extras must never sink the headline
             extras["lstm_lm_error"] = repr(e)[:200]
+    if RUN_EXTRAS:
+        # remaining BASELINE.json configs: VGG-16, MNIST, DeepFM
+        for key, fn, amp in (("vgg16_images_per_sec", bench_vgg, True),
+                             ("mnist_images_per_sec", bench_mnist, True),
+                             ("deepfm_examples_per_sec", bench_deepfm,
+                              False)):
+            try:
+                pt.reset_default_programs()
+                pt.reset_global_scope()
+                pt.amp.enable(amp and amp_on)
+                extras[key] = round(fn(pt), 0)
+            except Exception as e:
+                extras[key + "_error"] = repr(e)[:160]
     if os.environ.get("BENCH_TRANSFORMER", "1") == "1":
         try:
             pt.reset_default_programs()
