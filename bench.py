@@ -98,22 +98,32 @@ def _marginal_steps_per_sec(exe, program, feed, loss_var, n1=None,
     return med, spread
 
 
-def bench_resnet(pt):
-    from paddle_tpu.models import resnet
-    main_p, startup, f = resnet.build_train(
-        class_dim=1000, depth=50, image_shape=(3, 224, 224), lr=0.1)
+def _bench_image_model(pt, build, batch, image_shape, num_classes,
+                       n1=None, n2=None, repeats=None):
+    """Shared image-classification harness: build, init, frozen random
+    feed (frozen owning arrays are cached device-side by the executor,
+    so steady-state steps measure compute, not host-link re-uploads of
+    an identical batch), marginal timing. Returns (img/s, spread)."""
+    main_p, startup, f = build()
     exe = pt.Executor()
     exe.run(startup)
     rng = np.random.RandomState(0)
-    img = rng.rand(BATCH, 3, 224, 224).astype(np.float32)
-    label = rng.randint(0, 1000, (BATCH, 1)).astype(np.int32)
-    # Frozen arrays are cached device-side by the executor, so steady-state
-    # steps measure compute, not host-link re-uploads of an identical batch.
+    img = rng.rand(batch, *image_shape).astype(np.float32)
+    label = rng.randint(0, num_classes, (batch, 1)).astype(np.int32)
     img.flags.writeable = False
     label.flags.writeable = False
     feed = {"img": img, "label": label}
-    sps, spread = _marginal_steps_per_sec(exe, main_p, feed, f["loss"])
-    return BATCH * sps, spread
+    sps, spread = _marginal_steps_per_sec(exe, main_p, feed, f["loss"],
+                                          n1=n1, n2=n2, repeats=repeats)
+    return batch * sps, spread
+
+
+def bench_resnet(pt):
+    from paddle_tpu.models import resnet
+    return _bench_image_model(
+        pt, lambda: resnet.build_train(class_dim=1000, depth=50,
+                                       image_shape=(3, 224, 224), lr=0.1),
+        BATCH, (3, 224, 224), 1000)
 
 
 def _ensure_bench_shards(n_images=512, shards=4):
@@ -266,40 +276,21 @@ def bench_vgg(pt):
     """VGG-16 ImageNet-shape training (BASELINE config 2's second
     model; benchmark/fluid vgg.py)."""
     from paddle_tpu.models import vgg
-    b = 64
-    main_p, startup, f = vgg.build_train(class_dim=1000,
-                                         image_shape=(3, 224, 224),
-                                         lr=0.01)
-    exe = pt.Executor()
-    exe.run(startup)
-    rng = np.random.RandomState(0)
-    img = rng.rand(b, 3, 224, 224).astype(np.float32)
-    label = rng.randint(0, 1000, (b, 1)).astype(np.int32)
-    img.flags.writeable = False
-    label.flags.writeable = False
-    feed = {"img": img, "label": label}
-    sps, _ = _marginal_steps_per_sec(exe, main_p, feed, f["loss"],
-                                     repeats=1)
-    return b * sps
+    ips, _ = _bench_image_model(
+        pt, lambda: vgg.build_train(class_dim=1000,
+                                    image_shape=(3, 224, 224), lr=0.01),
+        64, (3, 224, 224), 1000, repeats=1)
+    return ips
 
 
 def bench_mnist(pt):
     """MNIST conv training (BASELINE config 1; tests/book
     recognize_digits)."""
     from paddle_tpu.models import mnist
-    b = 512
-    main_p, startup, f = mnist.build_train()
-    exe = pt.Executor()
-    exe.run(startup)
-    rng = np.random.RandomState(0)
-    img = rng.rand(b, 1, 28, 28).astype(np.float32)
-    label = rng.randint(0, 10, (b, 1)).astype(np.int32)
-    img.flags.writeable = False
-    label.flags.writeable = False
-    feed = {"img": img, "label": label}
-    sps, _ = _marginal_steps_per_sec(exe, main_p, feed, f["loss"],
-                                     n1=20, n2=120, repeats=1)
-    return b * sps
+    ips, _ = _bench_image_model(
+        pt, mnist.build_train, 512, (1, 28, 28), 10,
+        n1=20, n2=120, repeats=1)
+    return ips
 
 
 def bench_deepfm(pt):
