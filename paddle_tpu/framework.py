@@ -370,6 +370,12 @@ class Program:
                         op.attrs["is_test"] = True
         return p
 
+    def inference_optimize(self) -> "Program":
+        """Flip train-mode attrs (BN batch stats, dropout) to inference
+        (reference: framework.py:1046 / core.inference_optimize, run by
+        save_inference_model on the pruned program)."""
+        return self.clone(for_test=True)
+
     def to_string(self) -> str:
         return str(self.desc)
 

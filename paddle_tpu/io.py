@@ -88,6 +88,9 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     os.makedirs(dirname, exist_ok=True)
     fetch_names = [t.name for t in target_vars]
     pruned = _prune(program, feeded_var_names, fetch_names)
+    # inference mode: BN uses running stats, dropout is identity
+    # (reference: io.py:259/344 inference_optimize on the pruned program)
+    pruned = pruned.inference_optimize()
     # The program itself ships as compact PTIR binary written by the native
     # IR library (native/ir.cc), like the reference's protobuf __model__
     # (reference: io.py:298 writes program.desc.serialize_to_string()).

@@ -116,3 +116,52 @@ def test_duplicate_grad_accumulation():
     xv = np.array([[1.0, 2.0, 3.0]], np.float32)
     (gx,) = exe.run(main, feed={"x": xv}, fetch_list=[pairs[0][1]])
     np.testing.assert_allclose(gx, 2 * xv / 3.0, rtol=1e-5)
+
+
+def test_save_inference_model_flips_to_test_mode(tmp_path):
+    """save_inference_model must run inference_optimize on the pruned
+    program (reference io.py:259): reloaded BN uses RUNNING stats (so a
+    row's output is batch-independent) and dropout is identity
+    (deterministic outputs)."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [1, 8, 8], dtype="float32")
+        label = layers.data("label", [1], dtype="int64")
+        c = layers.conv2d(x, num_filters=4, filter_size=3, padding=1)
+        bn = layers.batch_norm(c, act="relu")
+        d = layers.dropout(bn, dropout_prob=0.5)
+        pred = layers.fc(d, size=3, act="softmax")
+        loss = layers.mean(layers.cross_entropy(input=pred, label=label))
+        pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    for _ in range(5):   # populate running stats
+        exe.run(main, feed={"x": rng.rand(16, 1, 8, 8).astype(np.float32),
+                            "label": rng.randint(0, 3, (16, 1))
+                            .astype(np.int64)},
+                fetch_list=[loss])
+    pt.io.save_inference_model(str(tmp_path / "m"), ["x"], [pred], exe,
+                               main)
+    prog, feeds, fetches = pt.io.load_inference_model(str(tmp_path / "m"),
+                                                      exe)
+    # every BN/dropout op in the reloaded program is in test mode
+    for op in prog.desc.global_block.ops:
+        if op.type in ("batch_norm", "dropout"):
+            assert op.attrs.get("is_test") is True, op.type
+
+    xa = rng.rand(1, 1, 8, 8).astype(np.float32)
+    xb = rng.rand(3, 1, 8, 8).astype(np.float32)
+    (pa,) = exe.run(prog, feed={feeds[0]: xa}, fetch_list=fetches)
+    (pa2,) = exe.run(prog, feed={feeds[0]: xa}, fetch_list=fetches)
+    # dropout off => deterministic
+    np.testing.assert_allclose(np.asarray(pa), np.asarray(pa2))
+    # BN running stats => a row's output is independent of batch mates
+    batch = np.concatenate([xa, xb], axis=0)
+    (pboth,) = exe.run(prog, feed={feeds[0]: batch}, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(pboth)[0], np.asarray(pa)[0],
+                               rtol=1e-4, atol=1e-5)
