@@ -1,0 +1,181 @@
+"""Multi-host sharded checkpointing for SPMD training state.
+
+The reference checkpoints through its pserver tier (go/pserver
+service.go:120-205 — each pserver saves its own parameter shard plus
+md5-verified metadata; trainers elect a saver). The TPU-native
+equivalent has no pserver: parameters are global jax.Arrays sharded
+over the mesh, so each PROCESS writes exactly the shard data it is
+responsible for (replica 0 of each piece), plus one JSON index written
+by process 0. Loading reassembles global arrays for a caller-supplied
+target sharding via jax.make_array_from_callback.
+
+Requirements: a filesystem all processes can reach (the standard
+checkpoint contract), and load-time shardings whose per-process pieces
+match the saved pieces exactly (same mesh topology — resharding on
+restore is out of scope; save/restore with the same parallel layout,
+as the reference's pserver shards do).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+
+from .checkpoint import _md5
+from ..core.scope import global_scope
+
+
+def _index_key(index, shape) -> str:
+    """Serialize a per-shard global index (tuple of slices), normalized
+    to concrete bounds so slice(None) and slice(0, dim) agree."""
+    parts = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        parts.append(f"{start}:{stop}")
+    return ",".join(parts)
+
+
+def _parse_index(key: str, shape):
+    out = []
+    if key:
+        for dim, part in zip(shape, key.split(",")):
+            a, b = part.split(":")
+            out.append(slice(int(a) if a else 0,
+                             int(b) if b else int(dim)))
+    return tuple(out)
+
+
+def save_sharded(dirname: str, names=None, scope=None) -> str:
+    """Each process writes `shard_<pid>.npz` holding the array pieces it
+    owns (replica 0 of each distinct shard); process 0 writes
+    `index.json` (var -> shape/dtype/piece map + per-file md5s)."""
+    scope = scope or global_scope()
+    if names is None:
+        names = list(scope.local_names())
+    os.makedirs(dirname, exist_ok=True)
+    pid = jax.process_index()
+    blobs: Dict[str, np.ndarray] = {}
+    index: Dict[str, dict] = {}
+    for name in names:
+        arr = scope.find(name)
+        if arr is None:
+            continue
+        entry = {"dtype": None, "shape": None, "pieces": []}
+        if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+            entry["shape"] = list(arr.shape)
+            entry["dtype"] = str(np.dtype(arr.dtype.name if hasattr(
+                arr.dtype, "name") else arr.dtype))
+            for s in arr.addressable_shards:
+                if s.replica_id != 0:
+                    continue     # one writer per distinct piece
+                key = _index_key(s.index, arr.shape)
+                blobs[f"{name}|{key}"] = np.asarray(s.data)
+                entry["pieces"].append({"index": key, "proc": pid})
+        else:
+            # replicated / host value: process 0 owns the whole array
+            a = np.asarray(arr)
+            entry["shape"] = list(a.shape)
+            entry["dtype"] = str(a.dtype)
+            if pid == 0:
+                blobs[f"{name}|"] = a
+                entry["pieces"].append({"index": "", "proc": 0})
+        index[name] = entry
+    shard_path = os.path.join(dirname, f"shard_{pid}.npz")
+    with open(shard_path, "wb") as f:
+        np.savez(f, **blobs)
+
+    # merge piece maps across processes through the coordinator:
+    # every process wrote its own npz; each also writes a tiny
+    # per-process piece list, and process 0 folds them into index.json
+    with open(os.path.join(dirname, f"pieces_{pid}.json"), "w") as f:
+        json.dump({n: e["pieces"] for n, e in index.items()}, f)
+    _barrier()
+    if pid == 0:
+        nproc = jax.process_count()
+        for other in range(nproc):
+            if other == pid:
+                continue
+            with open(os.path.join(dirname,
+                                   f"pieces_{other}.json")) as f:
+                for n, pieces in json.load(f).items():
+                    index.setdefault(n, {"pieces": []})
+                    index[n]["pieces"].extend(pieces)
+        md5s = {f"shard_{p}.npz": _md5(os.path.join(
+            dirname, f"shard_{p}.npz")) for p in range(nproc)}
+        with open(os.path.join(dirname, "index.json"), "w") as f:
+            json.dump({"vars": index, "md5": md5s,
+                       "nproc": nproc}, f)
+    _barrier()
+    return dirname
+
+
+def load_sharded(dirname: str,
+                 shardings: Optional[Dict[str, jax.sharding.Sharding]]
+                 = None,
+                 scope=None, verify: bool = True) -> None:
+    """Reassemble checkpointed vars into `scope`. Vars present in
+    `shardings` come back as GLOBAL jax.Arrays with that sharding
+    (per-process pieces must match the saved layout); others load as
+    host numpy arrays (from their saved pieces, which must cover the
+    full array on some single file — i.e. replicated saves)."""
+    scope = scope or global_scope()
+    shardings = shardings or {}
+    with open(os.path.join(dirname, "index.json")) as f:
+        meta = json.load(f)
+    if verify:
+        for fname, digest in meta["md5"].items():
+            path = os.path.join(dirname, fname)
+            if _md5(path) != digest:
+                raise IOError(f"checkpoint shard {fname} fails md5")
+    files = {}
+
+    def shard_file(proc):
+        if proc not in files:
+            files[proc] = np.load(os.path.join(dirname,
+                                               f"shard_{proc}.npz"))
+        return files[proc]
+
+    for name, entry in meta["vars"].items():
+        pieces = {p["index"]: p["proc"] for p in entry["pieces"]}
+        shape = tuple(entry.get("shape") or ())
+        if name in shardings:
+            sh = shardings[name]
+            dtype = np.dtype(entry["dtype"])
+
+            def cb(index, _name=name, _pieces=pieces, _shape=shape):
+                key = _index_key(index, _shape)
+                if key in _pieces:
+                    return shard_file(_pieces[key])[f"{_name}|{key}"]
+                if "" in _pieces:  # replicated save: slice the full copy
+                    full = shard_file(_pieces[""])[f"{_name}|"]
+                    return full[index]
+                raise KeyError(
+                    f"checkpoint has no piece {key!r} of {_name!r} — "
+                    "restore with the same sharding layout it was "
+                    "saved under")
+
+            arr = jax.make_array_from_callback(shape, sh, cb)
+            scope.set(name, arr)
+        else:
+            if "" in pieces:
+                scope.set(name, shard_file(pieces[""])[f"{name}|"])
+            else:
+                # assemble on host from the sharded pieces
+                dtype = np.dtype(entry["dtype"])
+                out = np.zeros(shape, dtype)
+                for key, proc in pieces.items():
+                    out[_parse_index(key, shape)] = \
+                        shard_file(proc)[f"{name}|{key}"]
+                scope.set(name, out)
+
+
+def _barrier():
+    """Cross-process sync point (no-op single-process)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("paddle_tpu_sharded_ckpt")

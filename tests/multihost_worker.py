@@ -81,6 +81,50 @@ def main():
     # second step exercises the already-global state path
     (lv2,) = pexe.run(main_p, feed={"x": Xl, "y": Yl}, fetch_list=[loss])
     assert np.isfinite(float(np.asarray(lv2).reshape(-1)[0]))
+
+    ckpt_dir = os.environ.get("PADDLE_TPU_TEST_CKPT")
+    if ckpt_dir:
+        # sharded checkpoint round-trip across BOTH processes: save the
+        # (global) params, clobber them, reload into the same
+        # shardings, verify bitwise restoration
+        from paddle_tpu.distributed.sharded_checkpoint import (
+            load_sharded, save_sharded)
+        w_ref = np.asarray(scope.get(w_name)).copy()
+        b_ref = np.asarray(scope.get(b_name)).copy()
+        # also a CROSS-PROCESS-SHARDED array (params above are
+        # replicated): rows split over the dcn axis, each process
+        # contributing its half
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        row_sh = NamedSharding(mesh, P("dcn"))
+        local_rows = np.full((2, 3), float(pid) + 1.0, np.float32)
+        sharded = jax.make_array_from_process_local_data(
+            row_sh, local_rows)
+        scope.set("ckpt_sharded_probe", sharded)
+        save_sharded(ckpt_dir,
+                     names=[w_name, b_name, "ckpt_sharded_probe"])
+        scope.set(w_name, np.zeros_like(w_ref))
+        scope.set(b_name, np.zeros_like(b_ref))
+        scope.set("ckpt_sharded_probe", np.zeros((4, 3), np.float32))
+        shardings = {
+            w_name: jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec()),
+            b_name: jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec()),
+            "ckpt_sharded_probe": row_sh,
+        }
+        load_sharded(ckpt_dir, shardings=shardings)
+        np.testing.assert_allclose(np.asarray(scope.get(w_name)), w_ref)
+        np.testing.assert_allclose(np.asarray(scope.get(b_name)), b_ref)
+        probe = scope.get("ckpt_sharded_probe")
+        for s in probe.addressable_shards:
+            np.testing.assert_allclose(np.asarray(s.data),
+                                       float(pid) + 1.0)
+        # restored arrays are GLOBAL again and trainable
+        (lv3,) = pexe.run(main_p, feed={"x": Xl, "y": Yl},
+                          fetch_list=[loss])
+        assert np.isfinite(float(np.asarray(lv3).reshape(-1)[0]))
+        print(f"CKPT_OK pid={pid}")
+
     print(f"MULTIHOST_WORKER_OK pid={pid} loss={float(np.asarray(lv)):.5f}")
 
 

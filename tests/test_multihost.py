@@ -65,11 +65,13 @@ def test_cluster_env_partial_jax_spelling_raises():
         cluster_env({"COORDINATOR_ADDRESS": "10.0.0.2:1234"})
 
 
-def test_two_process_spmd_gradient_allreduce():
+def test_two_process_spmd_gradient_allreduce(tmp_path):
     """Two REAL processes join one jax.distributed job via the
     PADDLE_INIT_* contract and train fit_a_line data-parallel; each
     worker verifies the post-step params equal the full-batch update
-    (impossible without the cross-process gradient all-reduce)."""
+    (impossible without the cross-process gradient all-reduce), then
+    round-trips a sharded checkpoint (each process saving its own
+    pieces — the SPMD analog of the pserver checkpoint)."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -84,6 +86,7 @@ def test_two_process_spmd_gradient_allreduce():
             "PADDLE_INIT_NUM_TRAINERS": "2",
             "PADDLE_INIT_TRAINER_ID": str(pid),
             "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "PADDLE_TPU_TEST_CKPT": str(tmp_path / "ckpt"),
         })
         procs.append(subprocess.Popen(
             [sys.executable, os.path.join(REPO, "tests",
@@ -102,3 +105,4 @@ def test_two_process_spmd_gradient_allreduce():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
         assert f"MULTIHOST_WORKER_OK pid={pid}" in out, out[-2000:]
+        assert f"CKPT_OK pid={pid}" in out, out[-2000:]

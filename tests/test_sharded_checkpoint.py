@@ -1,0 +1,45 @@
+"""Sharded SPMD checkpointing (distributed/sharded_checkpoint.py) —
+single-process paths; the true cross-process pieces path runs inside
+tests/test_multihost.py's 2-process worker."""
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed.sharded_checkpoint import (load_sharded,
+                                                       save_sharded)
+from paddle_tpu.parallel import make_mesh
+
+
+def test_single_process_roundtrip_with_shardings(tmp_path):
+    mesh = make_mesh((8,), ("data",), devices=jax.devices()[:8])
+    scope = pt.global_scope()
+    rng = np.random.RandomState(0)
+    w = rng.randn(16, 4).astype(np.float32)
+    host = rng.randn(3).astype(np.float32)
+    scope.set("w", jax.device_put(w, NamedSharding(mesh, P("data"))))
+    scope.set("hostv", host)
+    d = str(tmp_path / "ck")
+    save_sharded(d, names=["w", "hostv"])
+
+    scope.set("w", np.zeros_like(w))
+    scope.set("hostv", np.zeros_like(host))
+    load_sharded(d, shardings={"w": NamedSharding(mesh, P("data"))})
+    np.testing.assert_allclose(np.asarray(scope.get("w")), w)
+    assert scope.get("w").sharding.spec == P("data")
+    np.testing.assert_allclose(np.asarray(scope.get("hostv")), host)
+
+
+def test_md5_verification_rejects_corruption(tmp_path):
+    scope = pt.global_scope()
+    scope.set("v", np.arange(6, dtype=np.float32))
+    d = str(tmp_path / "ck")
+    save_sharded(d, names=["v"])
+    with open(f"{d}/shard_0.npz", "r+b") as f:
+        f.seek(200)           # inside the stored array payload
+        byte = f.read(1)
+        f.seek(200)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.raises(IOError, match="md5"):
+        load_sharded(d)
