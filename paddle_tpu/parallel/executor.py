@@ -75,6 +75,13 @@ class ParallelExecutor(Executor):
         # must be lifted to global arrays before entering the jit)
         self._multiprocess = len(
             {d.process_index for d in self.mesh.devices.flat}) > 1
+        self._state_shardings: Dict[str, NamedSharding] = {}
+
+    def state_shardings(self) -> Dict[str, NamedSharding]:
+        """Per-state-var NamedShardings from the latest compile —
+        exactly what distributed.sharded_checkpoint.load_sharded needs
+        to restore this executor's state onto the mesh."""
+        return dict(self._state_shardings)
 
     def run(self, program, feed=None, **kw):
         if self._multiprocess and feed:
@@ -211,6 +218,8 @@ class ParallelExecutor(Executor):
             n: NamedSharding(mesh, state_spec(n)) for n in ro_names}
         rw_shardings = {
             n: NamedSharding(mesh, state_spec(n)) for n in rw_names}
+        self._state_shardings.update(ro_shardings)
+        self._state_shardings.update(rw_shardings)
 
         # Input shardings (sharded batch + replicated-or-TP params)
         # determine the SPMD partitioning, including the gradient

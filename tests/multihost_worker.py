@@ -105,13 +105,12 @@ def main():
         scope.set(w_name, np.zeros_like(w_ref))
         scope.set(b_name, np.zeros_like(b_ref))
         scope.set("ckpt_sharded_probe", np.zeros((4, 3), np.float32))
-        shardings = {
-            w_name: jax.sharding.NamedSharding(
-                mesh, jax.sharding.PartitionSpec()),
-            b_name: jax.sharding.NamedSharding(
-                mesh, jax.sharding.PartitionSpec()),
-            "ckpt_sharded_probe": row_sh,
-        }
+        # the executor knows its state shardings — users restore with
+        # them directly instead of hand-building PartitionSpecs
+        shardings = {n: sh for n, sh in pexe.state_shardings().items()
+                     if n in (w_name, b_name)}
+        assert set(shardings) == {w_name, b_name}
+        shardings["ckpt_sharded_probe"] = row_sh
         load_sharded(ckpt_dir, shardings=shardings)
         np.testing.assert_allclose(np.asarray(scope.get(w_name)), w_ref)
         np.testing.assert_allclose(np.asarray(scope.get(b_name)), b_ref)
