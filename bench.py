@@ -338,6 +338,24 @@ def bench_lstm_lm(pt):
     return b * t * sps
 
 
+def _run_extra(pt, extras, amp_flag, fn):
+    """One extra metric: fresh programs/scope, AMP set, failures and
+    progress isolated from the headline (a killed run still leaves the
+    completed extras visible on stderr)."""
+    import sys
+    try:
+        pt.reset_default_programs()
+        pt.reset_global_scope()
+        pt.amp.enable(amp_flag)
+        result = fn()
+        extras.update(result)
+        print(f"[bench] {result}", file=sys.stderr, flush=True)
+    except Exception as e:
+        extras[fn.__name__ + "_error"] = repr(e)[:200]
+        print(f"[bench] {fn.__name__} failed: {e!r}"[:220],
+              file=sys.stderr, flush=True)
+
+
 def main():
     import paddle_tpu as pt
 
@@ -348,61 +366,54 @@ def main():
 
     images_per_sec, resnet_spread = bench_resnet(pt)
 
+    # extras in importance order (the tunnel-sensitive real-input
+    # measurement goes LAST so a truncated run keeps the headline set)
     extras = {}
-    if os.environ.get("BENCH_REAL_INPUT", "1") == "1":
-        try:
-            pt.reset_default_programs()
-            pt.reset_global_scope()
-            real_ips, pipeline_ips = bench_resnet_real_input(pt)
-            extras["resnet50_real_input_images_per_sec"] = round(
-                real_ips, 2)
-            extras["host_input_pipeline_images_per_sec"] = round(
-                pipeline_ips, 2)
-            # can the host pipeline keep the chip fed? (>1 means yes;
-            # the tunnel's flat per-novel-arg execute penalty caps the
-            # end-to-end number on this link — see MFU_BREAKDOWN.md)
-            extras["host_pipeline_vs_compute"] = round(
-                pipeline_ips / images_per_sec, 3)
-        except Exception as e:
-            extras["real_input_error"] = repr(e)[:200]
-    if RUN_EXTRAS:
-        try:
-            pt.reset_default_programs()
-            pt.reset_global_scope()
-            # scan LSTM is latency-bound, not MXU-bound: bf16 casts around
-            # the small recurrent matmuls only add overhead
-            pt.amp.enable(False)
-            tok_s = bench_lstm_lm(pt)
-            extras["lstm_lm_tokens_per_sec"] = round(tok_s, 0)
-            extras["lstm_lm_vs_baseline"] = round(
-                tok_s / BASELINE_LSTM_TOKENS_PER_SEC, 2)
-        except Exception as e:  # extras must never sink the headline
-            extras["lstm_lm_error"] = repr(e)[:200]
-    if RUN_EXTRAS:
-        # remaining BASELINE.json configs: VGG-16, MNIST, DeepFM
-        for key, fn, amp in (("vgg16_images_per_sec", bench_vgg, True),
-                             ("mnist_images_per_sec", bench_mnist, True),
-                             ("deepfm_examples_per_sec", bench_deepfm,
-                              False)):
-            try:
-                pt.reset_default_programs()
-                pt.reset_global_scope()
-                pt.amp.enable(amp and amp_on)
-                extras[key] = round(fn(pt), 0)
-            except Exception as e:
-                extras[key + "_error"] = repr(e)[:160]
+
+    def x_transformer():
+        t = bench_transformer(pt)
+        return {"transformer_tokens_per_sec": round(t, 0),
+                "transformer_mfu_est": round(
+                    t * TRANSFORMER_FLOPS_PER_TOKEN / V5E_PEAK_FLOPS, 3)}
+
+    def x_lstm():
+        # scan LSTM is latency-bound, not MXU-bound: bf16 casts around
+        # the small recurrent matmuls only add overhead
+        t = bench_lstm_lm(pt)
+        return {"lstm_lm_tokens_per_sec": round(t, 0),
+                "lstm_lm_vs_baseline": round(
+                    t / BASELINE_LSTM_TOKENS_PER_SEC, 2)}
+
+    def x_vgg():
+        return {"vgg16_images_per_sec": round(bench_vgg(pt), 0)}
+
+    def x_mnist():
+        return {"mnist_images_per_sec": round(bench_mnist(pt), 0)}
+
+    def x_deepfm():
+        return {"deepfm_examples_per_sec": round(bench_deepfm(pt), 0)}
+
+    def x_real_input():
+        real_ips, pipeline_ips = bench_resnet_real_input(pt)
+        # host_pipeline_vs_compute > 1 means the pipeline keeps the chip
+        # fed; the tunnel's flat per-novel-arg execute penalty caps the
+        # end-to-end number on this link — see MFU_BREAKDOWN.md
+        return {"resnet50_real_input_images_per_sec": round(real_ips, 2),
+                "host_input_pipeline_images_per_sec": round(
+                    pipeline_ips, 2),
+                "host_pipeline_vs_compute": round(
+                    pipeline_ips / images_per_sec, 3)}
+
     if os.environ.get("BENCH_TRANSFORMER", "1") == "1":
-        try:
-            pt.reset_default_programs()
-            pt.reset_global_scope()
-            pt.amp.enable(amp_on)   # honor the PADDLE_TPU_AMP override
-            t_tok_s = bench_transformer(pt)
-            extras["transformer_tokens_per_sec"] = round(t_tok_s, 0)
-            extras["transformer_mfu_est"] = round(
-                t_tok_s * TRANSFORMER_FLOPS_PER_TOKEN / V5E_PEAK_FLOPS,
-                3)
-        except Exception as e:
-            extras["transformer_error"] = repr(e)[:200]
+        _run_extra(pt, extras, amp_on, x_transformer)
+    if RUN_EXTRAS:
+        _run_extra(pt, extras, False, x_lstm)
+        _run_extra(pt, extras, amp_on, x_vgg)
+        _run_extra(pt, extras, amp_on, x_mnist)
+        _run_extra(pt, extras, False, x_deepfm)
+    if os.environ.get("BENCH_REAL_INPUT", "1") == "1":
+        _run_extra(pt, extras, amp_on, x_real_input)
+    pt.amp.enable(amp_on)
     extras["resnet_spread_pct"] = round(100 * resnet_spread, 1)
     extras["resnet_mfu_est"] = round(
         images_per_sec * RESNET50_TRAIN_FLOPS_PER_IMAGE / V5E_PEAK_FLOPS,
