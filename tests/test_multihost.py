@@ -72,36 +72,44 @@ def test_two_process_spmd_gradient_allreduce(tmp_path):
     (impossible without the cross-process gradient all-reduce), then
     round-trips a sharded checkpoint (each process saving its own
     pieces — the SPMD analog of the pserver checkpoint)."""
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    def spawn_and_wait(attempt):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = []
+        for pid in range(2):
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)  # children pick their own devices
+            env.update({
+                "PADDLE_INIT_PSERVERS": "127.0.0.1",
+                "PADDLE_INIT_PORT": str(port),
+                "PADDLE_INIT_NUM_TRAINERS": "2",
+                "PADDLE_INIT_TRAINER_ID": str(pid),
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                "PADDLE_TPU_TEST_CKPT": str(tmp_path
+                                            / f"ckpt{attempt}"),
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.join(REPO, "tests",
+                                              "multihost_worker.py")],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=600)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        return procs, outs
 
-    procs = []
-    for pid in range(2):
-        env = dict(os.environ)
-        env.pop("XLA_FLAGS", None)  # children pick their own device count
-        env.update({
-            "PADDLE_INIT_PSERVERS": "127.0.0.1",
-            "PADDLE_INIT_PORT": str(port),
-            "PADDLE_INIT_NUM_TRAINERS": "2",
-            "PADDLE_INIT_TRAINER_ID": str(pid),
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-            "PADDLE_TPU_TEST_CKPT": str(tmp_path / "ckpt"),
-        })
-        procs.append(subprocess.Popen(
-            [sys.executable, os.path.join(REPO, "tests",
-                                          "multihost_worker.py")],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True))
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=600)
-            outs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+    procs, outs = spawn_and_wait(0)
+    if any(p.returncode != 0 for p in procs) and \
+            any("bind" in o.lower() or "address already in use"
+                in o.lower() for o in outs):
+        procs, outs = spawn_and_wait(1)  # port was raced; retry once
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
         assert f"MULTIHOST_WORKER_OK pid={pid}" in out, out[-2000:]
