@@ -316,6 +316,30 @@ def bench_deepfm(pt):
     return b * sps
 
 
+def bench_resnet_infer(pt):
+    """Saved-model inference throughput: the save_inference_model ->
+    load_inference_model product (pruned, test-mode BN) serving a
+    batch — the N19 inference-lib capability measured end to end."""
+    import tempfile
+
+    from paddle_tpu.models import resnet
+
+    b = 256
+    main_p, startup, f = resnet.build_train(class_dim=1000, depth=50)
+    exe = pt.Executor()
+    exe.run(startup)
+    with tempfile.TemporaryDirectory() as d:
+        pt.io.save_inference_model(d, ["img"], [f["pred"]], exe, main_p)
+        prog, feeds, fetches = pt.io.load_inference_model(d, exe)
+    rng = np.random.RandomState(0)
+    img = rng.rand(b, 3, 224, 224).astype(np.float32)
+    img.flags.writeable = False
+    feed = {feeds[0]: img}
+    sps, _ = _marginal_steps_per_sec(exe, prog, feed, fetches[0],
+                                     repeats=1)
+    return b * sps
+
+
 def bench_lstm_lm(pt):
     from paddle_tpu.models import lstm_lm
     from paddle_tpu.core.lod import RaggedPair
@@ -393,6 +417,10 @@ def main():
     def x_deepfm():
         return {"deepfm_examples_per_sec": round(bench_deepfm(pt), 0)}
 
+    def x_infer():
+        return {"resnet50_infer_images_per_sec": round(
+            bench_resnet_infer(pt), 0)}
+
     def x_real_input():
         real_ips, pipeline_ips = bench_resnet_real_input(pt)
         # host_pipeline_vs_compute > 1 means the pipeline keeps the chip
@@ -411,6 +439,7 @@ def main():
         _run_extra(pt, extras, amp_on, x_vgg)
         _run_extra(pt, extras, amp_on, x_mnist)
         _run_extra(pt, extras, False, x_deepfm)
+        _run_extra(pt, extras, amp_on, x_infer)
     if os.environ.get("BENCH_REAL_INPUT", "1") == "1":
         _run_extra(pt, extras, amp_on, x_real_input)
     pt.amp.enable(amp_on)
