@@ -144,7 +144,6 @@ def load_sharded(dirname: str,
         shape = tuple(entry.get("shape") or ())
         if name in shardings:
             sh = shardings[name]
-            dtype = np.dtype(entry["dtype"])
 
             def cb(index, _name=name, _pieces=pieces, _shape=shape):
                 key = _index_key(index, _shape)
