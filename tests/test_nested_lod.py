@@ -434,3 +434,34 @@ def test_tree_feed_under_parallel_executor():
     assert isinstance(out, LoDTensor) and out.lod == t.lod
     np.testing.assert_allclose(out.data, t.data * 2.0, rtol=1e-6)
     assert np.isfinite(np.asarray(pooled)).all()
+
+
+def test_host_tree_roundtrip_depth4():
+    """Depth is genuinely arbitrary: 4-level nesting round-trips
+    through the dense tree form and the in-graph flatten chain."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.lod import RaggedTree
+    rng = np.random.RandomState(13)
+    corpora = []
+    for i in range(2):
+        docs = []
+        for _ in range(i + 1):
+            paras = [[rng.rand(rng.randint(1, 3), 2).astype(np.float32)
+                      for _ in range(rng.randint(1, 3))]
+                     for _ in range(rng.randint(1, 3))]
+            docs.append(paras)
+        corpora.append(docs)
+    t = LoDTensor.from_depth_sequences(corpora, depth=4, feat_shape=(2,))
+    assert len(t.lod) == 4
+    data, lengths = t.to_tree_padded()
+    assert data.ndim == 6 and [l.ndim for l in lengths] == [1, 2, 3, 4]
+    back = LoDTensor.from_tree_padded(data, lengths)
+    assert back.lod == t.lod
+    np.testing.assert_allclose(back.data, t.data)
+    # peel 4 -> 3 -> 2 in-graph
+    rt = RaggedTree(jnp.asarray(data), tuple(jnp.asarray(l)
+                                             for l in lengths))
+    d3 = rt.flatten()
+    assert isinstance(d3, RaggedTree) and d3.depth == 3
+    d2 = d3.flatten()
+    assert isinstance(d2, RaggedNested)
