@@ -381,3 +381,29 @@ def test_ingraph_go_multiple_sends_fifo():
     exe.run(startup)
     v1, v2 = exe.run(main, fetch_list=[r1, r2])
     assert float(np.asarray(v1)) == 1.0 and float(np.asarray(v2)) == 2.0
+
+
+def test_ingraph_select_mixed_send_recv_cases():
+    """Mixed case list: recv on an empty channel + send into one with
+    space — the send case must fire and the recv output stays zeros."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        empty = layers.make_channel(capacity=1)
+        room = layers.make_channel(capacity=1)
+        v = layers.fill_constant([2], "float32", 4.0)
+        idx, (r,) = layers.select([
+            ("recv", empty, [2], "float32"),
+            ("send", room, v),
+        ])
+        got = layers.channel_recv(room, shape=[2], dtype="float32")
+        layers.channel_close(empty)
+        layers.channel_close(room)
+    exe = pt.Executor()
+    exe.run(startup)
+    iv, rv, gv = exe.run(main, fetch_list=[idx, r, got])
+    assert int(np.asarray(iv)) == 1
+    np.testing.assert_allclose(np.asarray(rv), 0.0)   # recv didn't fire
+    np.testing.assert_allclose(np.asarray(gv), 4.0)   # send landed

@@ -117,3 +117,48 @@ def test_forward_only_unbounded_while_needs_no_probe():
     assert float(np.asarray(iv).reshape(())) == 5.0
     assert int(np.asarray(steps)) == 5
     assert not exe._probe_cache
+
+
+def test_two_dynamic_whiles_in_one_program():
+    """Two unbounded Whiles with different data-dependent trip counts
+    in ONE program: the probe measures both, and both gradients flow."""
+    lr = 0.01
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.create_parameter(
+            shape=[1], dtype="float32", name="xp2",
+            default_initializer=pt.initializer.ConstantInitializer(0.4))
+        thr1 = layers.data("thr1", [1], dtype="float32")
+        thr2 = layers.data("thr2", [1], dtype="float32")
+
+        def loop(thr):
+            s = layers.fill_constant([1], "float32", 0.0)
+            s.stop_gradient = False
+            cond = cf.less_than_v(s, thr)
+            w = cf.While(cond)
+            with w.block():
+                t = layers.elementwise_add(s, x)
+                layers.assign(t, output=s)
+                cf.less_than_v(s, thr, cond=cond)
+            return s, w
+
+        s1, w1 = loop(thr1)
+        s2, w2 = loop(thr2)
+        loss = layers.reduce_sum(layers.elementwise_add(
+            layers.square(s1), layers.square(s2)))
+        pt.optimizer.SGDOptimizer(learning_rate=lr).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    x0 = 0.4
+    lv, n1, n2 = exe.run(
+        main, feed={"thr1": np.asarray([1.0], np.float32),
+                    "thr2": np.asarray([2.0], np.float32)},
+        fetch_list=[loss, w1.steps, w2.steps])
+    # x=0.4: s1 walks to 1.2 in 3 steps, s2 to 2.0 in 5 steps
+    assert int(np.asarray(n1)) == 3 and int(np.asarray(n2)) == 5
+    np.testing.assert_allclose(float(np.asarray(lv)),
+                               1.2 ** 2 + 2.0 ** 2, rtol=1e-5)
+    # d loss / dx = 2*s1*n1 + 2*s2*n2
+    g_expect = 2 * 1.2 * 3 + 2 * 2.0 * 5
+    x1 = float(np.asarray(pt.global_scope().get("xp2")).reshape(()))
+    np.testing.assert_allclose((x0 - x1) / lr, g_expect, rtol=1e-4)
