@@ -365,19 +365,26 @@ def bench_lstm_lm(pt):
 def _run_extra(pt, extras, amp_flag, fn):
     """One extra metric: fresh programs/scope, AMP set, failures and
     progress isolated from the headline (a killed run still leaves the
-    completed extras visible on stderr)."""
+    completed extras visible on stderr). Transient tunnel errors
+    (remote_compile connection drops) get one retry."""
     import sys
-    try:
-        pt.reset_default_programs()
-        pt.reset_global_scope()
-        pt.amp.enable(amp_flag)
-        result = fn()
-        extras.update(result)
-        print(f"[bench] {result}", file=sys.stderr, flush=True)
-    except Exception as e:
-        extras[fn.__name__ + "_error"] = repr(e)[:200]
-        print(f"[bench] {fn.__name__} failed: {e!r}"[:220],
-              file=sys.stderr, flush=True)
+    for attempt in (0, 1):
+        try:
+            pt.reset_default_programs()
+            pt.reset_global_scope()
+            pt.amp.enable(amp_flag)
+            result = fn()
+            extras.update(result)
+            print(f"[bench] {result}", file=sys.stderr, flush=True)
+            return
+        except Exception as e:
+            transient = "remote_compile" in repr(e) or \
+                "INTERNAL" in repr(e)
+            print(f"[bench] {fn.__name__} attempt {attempt} failed: "
+                  f"{e!r}"[:220], file=sys.stderr, flush=True)
+            if not (transient and attempt == 0):
+                extras[fn.__name__ + "_error"] = repr(e)[:200]
+                return
 
 
 def main():
