@@ -14,6 +14,7 @@ in-place on device just like the reference's in-place kernels.
 """
 from __future__ import annotations
 
+import atexit
 import os
 import warnings
 import weakref
@@ -26,7 +27,7 @@ import jax.numpy as jnp
 from ..amp import amp_enabled
 from .ir import Program, BlockDesc, OpDesc
 from .lod import LoDTensor, RaggedNested, RaggedPair, RaggedTree
-from .registry import run_op
+from .registry import OpRegistry, run_op
 from .scope import Scope, global_scope
 
 STEP_VAR = "@step_counter@"
@@ -281,6 +282,43 @@ def _next_pow2(n: int) -> int:
     return 1 << max(0, int(n) - 1).bit_length() if n > 1 else 1
 
 
+def _stateful_ops_in(program: Program, ops) -> List[str]:
+    """Op types with host-side effects (ordered io_callback: channel
+    send/recv, select, go, ...) reachable from `ops`, including
+    sub-blocks. The WhileGrad probe re-executes its forward prefix, so a
+    stateful op there would fire twice per step — desyncing channel
+    protocols. Detected and rejected rather than silently doubled."""
+    found: List[str] = []
+
+    def visit(op_list):
+        for op in op_list:
+            if OpRegistry.has(op.type) and OpRegistry.get(op.type).stateful:
+                found.append(op.type)
+            for attr in ("sub_block", "sub_block_idx", "true_block_idx",
+                         "false_block_idx"):
+                idx = op.attrs.get(attr)
+                if isinstance(idx, int) and 0 <= idx < len(program.blocks):
+                    visit(program.blocks[idx].ops)
+
+    visit(ops)
+    return found
+
+
+# Deferred bounded-While truncation flags are normally checked one run
+# later (so the warn path never syncs the just-dispatched step); flush
+# them at interpreter exit so a truncation on a session's FINAL run
+# still warns without requiring Executor.close().
+_LIVE_EXECUTORS: "weakref.WeakSet[Executor]" = weakref.WeakSet()
+
+
+@atexit.register
+def _flush_deferred_while_flags():
+    for ex in list(_LIVE_EXECUTORS):
+        flags, ex._deferred_flags = ex._deferred_flags, []
+        for key, v in flags:
+            _check_while_flag(key, v, raise_=False)
+
+
 class Executor:
     """Runs Programs. `place` is accepted for API parity; JAX device
     selection is global (TPU if present, else CPU)."""
@@ -293,6 +331,7 @@ class Executor:
         # one step later so the warn-by-default path never forces a
         # device sync on the just-dispatched step
         self._deferred_flags: List[Tuple[Tuple, Any]] = []
+        _LIVE_EXECUTORS.add(self)
 
     # ------------------------------------------------------------------
     def _probe_while_bounds(self, program: Program, block: BlockDesc,
@@ -308,6 +347,15 @@ class Executor:
         targets, prefix = _dynamic_while_targets(block)
         if not targets:
             return None
+        stateful = _stateful_ops_in(program, block.ops[:prefix])
+        if stateful:
+            raise RuntimeError(
+                "cannot differentiate an unbounded While in a program "
+                f"whose forward prefix has stateful ops {sorted(set(stateful))}: "
+                "the trip-count probe re-executes that prefix, which "
+                "would fire each channel/select/go op twice per step. "
+                "Give the While an explicit max_steps, or move the CSP "
+                "ops after the last dynamic While.")
         steps_names = list(targets.values())
         pkey = (program.uid, program.version, feed_sig, block_idx,
                 "__probe__")

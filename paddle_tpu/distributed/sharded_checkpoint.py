@@ -91,8 +91,12 @@ def save_sharded(dirname: str, names=None, scope=None) -> str:
     # merge piece maps across processes through the coordinator:
     # every process wrote its own npz; each also writes a tiny
     # per-process piece list, and process 0 folds them into index.json
+    # shape/dtype ride along so a var absent from process 0's scope
+    # still gets full metadata in index.json (otherwise load_sharded
+    # would reconstruct it as a dtype-less scalar)
     with open(os.path.join(dirname, f"pieces_{pid}.json"), "w") as f:
-        json.dump({n: e["pieces"] for n, e in index.items()}, f)
+        json.dump({n: {"pieces": e["pieces"], "shape": e["shape"],
+                       "dtype": e["dtype"]} for n, e in index.items()}, f)
     _barrier()
     if pid == 0:
         nproc = jax.process_count()
@@ -101,9 +105,13 @@ def save_sharded(dirname: str, names=None, scope=None) -> str:
                 continue
             with open(os.path.join(dirname,
                                    f"pieces_{other}.json")) as f:
-                for n, pieces in json.load(f).items():
-                    index.setdefault(n, {"pieces": []})
-                    index[n]["pieces"].extend(pieces)
+                for n, rec in json.load(f).items():
+                    entry = index.setdefault(
+                        n, {"dtype": None, "shape": None, "pieces": []})
+                    if entry.get("shape") is None:
+                        entry["shape"] = rec["shape"]
+                        entry["dtype"] = rec["dtype"]
+                    entry["pieces"].extend(rec["pieces"])
         md5s = {f"shard_{p}.npz": _md5(os.path.join(
             dirname, f"shard_{p}.npz")) for p in range(nproc)}
         with open(os.path.join(dirname, "index.json"), "w") as f:

@@ -115,7 +115,7 @@ class Go:
                    "captured_names": captured})
 
 
-def select(cases, timeout: float = -1.0):
+def select(cases, timeout: float = -1.0, return_ok: bool = False):
     """In-graph multi-way select (reference: select_op.cc; Go
     semantics — pick one ready case, block until some case is ready).
 
@@ -126,7 +126,10 @@ def select(cases, timeout: float = -1.0):
     Returns (case_index, recv_outs): case_index is an int32 scalar var
     naming the fired case (branch on it with IfElse/cond/switch);
     recv_outs holds one output var per recv case, in case order (the
-    received value when that case fired, zeros otherwise)."""
+    received value when that case fired, zeros otherwise). With
+    return_ok=True also returns recv_ok, an int32 [n_recv] var whose
+    fired slot is 1 iff the recv delivered a real value — 0 means the
+    case fired because its channel closed (Go's `v, ok := <-ch`)."""
     helper = LayerHelper("select")
     channels, send_x, kinds = [], [], []
     recv_shapes, recv_dtypes, recv_outs = [], [], []
@@ -148,10 +151,18 @@ def select(cases, timeout: float = -1.0):
     inputs = {"Channels": channels}
     if send_x:
         inputs["SendX"] = send_x
+    outputs = {"CaseIndex": idx, "Out": recv_outs}
+    recv_ok = None
+    if recv_outs:
+        recv_ok = helper.create_tmp_variable("int32",
+                                             shape=[len(recv_outs)])
+        outputs["RecvOk"] = recv_ok
     helper.append_op(type="select", inputs=inputs,
-                     outputs={"CaseIndex": idx, "Out": recv_outs},
+                     outputs=outputs,
                      attrs={"kinds": kinds,
                             "timeout": float(timeout),
                             "recv_shapes": recv_shapes,
                             "recv_dtypes": recv_dtypes})
+    if return_ok:
+        return idx, recv_outs, recv_ok
     return idx, recv_outs

@@ -199,10 +199,12 @@ def _host_select(cids, *send_vals, kinds, timeout, recv_specs):
         if kind == "recv":
             recv_slot[i] = len(recv_slot)
     fired_value = {}
+    fired_ok = {}    # case index -> did the recv deliver a real value?
     si = 0
 
     def make_recv_cb(i):
         def cb(v, ok):
+            fired_ok[i] = bool(ok)
             if ok:
                 fired_value[i] = np.asarray(v)
         return cb
@@ -236,7 +238,16 @@ def _host_select(cids, *send_vals, kinds, timeout, recv_specs):
         want = recv_out[slot]
         recv_out[slot] = buf.astype(want.dtype, copy=False).reshape(
             want.shape)
-    return (np.int32(idx),) + tuple(recv_out)
+    # ok flag per recv case: 1 iff that case fired AND delivered a real
+    # value — a recv that fired because its channel CLOSED reads 0, so
+    # callers can tell a genuine zero value from a closed-channel zero
+    # (the reference select / host concurrency.select expose the same ok)
+    ok_vec = np.zeros(len(recv_slot), np.int32)
+    if idx in recv_slot:
+        ok_vec[recv_slot[idx]] = np.int32(1 if fired_ok.get(idx) else 0)
+    if recv_slot:
+        return (np.int32(idx), ok_vec) + tuple(recv_out)
+    return (np.int32(idx),)
 
 
 @register_op("select", stateful=True,
@@ -250,8 +261,10 @@ def _select(ctx):
     surrounding channel ops and interoperates with host go() threads.
 
     Outputs: CaseIndex (int32 scalar — downstream control flow branches
-    on it with IfElse/cond/switch) and one Out per recv case (the
-    received value when that case fired, zeros otherwise)."""
+    on it with IfElse/cond/switch), RecvOk (int32 [n_recv]: 1 at the
+    fired recv's slot iff it delivered a real value, 0 when it fired on
+    a closed channel), and one Out per recv case (the received value
+    when that case fired, zeros otherwise)."""
     cids = ctx.inputs("Channels")
     send_vals = ctx.inputs("SendX") or []
     kinds = list(ctx.attr("kinds"))
@@ -269,9 +282,11 @@ def _select(ctx):
         raise ValueError(f"select got {len(cids)} channels for "
                          f"{len(kinds)} case kinds")
 
-    out_shapes = (jax.ShapeDtypeStruct((), jnp.int32),) + tuple(
-        jax.ShapeDtypeStruct(shape, jnp_dtype(dt))
-        for shape, dt in recv_specs)
+    out_shapes = (jax.ShapeDtypeStruct((), jnp.int32),)
+    if recv_specs:
+        out_shapes += (jax.ShapeDtypeStruct((len(recv_specs),), jnp.int32),)
+    out_shapes += tuple(jax.ShapeDtypeStruct(shape, jnp_dtype(dt))
+                        for shape, dt in recv_specs)
     cid_vec = jnp.stack([jnp.asarray(c, jnp.int32).reshape(())
                          for c in cids])
     res = jax.experimental.io_callback(
@@ -279,4 +294,6 @@ def _select(ctx):
                           timeout=timeout, recv_specs=tuple(recv_specs)),
         out_shapes, cid_vec, *send_vals, ordered=True)
     ctx.set_output("CaseIndex", res[0])
-    ctx.set_outputs("Out", list(res[1:]))
+    if recv_specs:
+        ctx.set_output("RecvOk", res[1])   # no-op if not wired
+        ctx.set_outputs("Out", list(res[2:]))

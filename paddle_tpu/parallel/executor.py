@@ -76,6 +76,9 @@ class ParallelExecutor(Executor):
         self._multiprocess = len(
             {d.process_index for d in self.mesh.devices.flat}) > 1
         self._state_shardings: Dict[str, NamedSharding] = {}
+        # globalized read-only state produced inside the compiled call;
+        # run() drains it into the run-time scope after each step
+        self._pending_ro_globals: Dict[str, Any] = {}
 
     def state_shardings(self) -> Dict[str, NamedSharding]:
         """Per-state-var NamedShardings from the latest compile —
@@ -88,7 +91,14 @@ class ParallelExecutor(Executor):
             feed = {
                 name: self._globalize_feed(name, v)
                 for name, v in feed.items()}
-        return super().run(program, feed=feed, **kw)
+        self._pending_ro_globals.clear()
+        out = super().run(program, feed=feed, **kw)
+        if self._pending_ro_globals:
+            sc = kw.get("scope") or global_scope()
+            for n, g in self._pending_ro_globals.items():
+                sc.set(n, g)
+            self._pending_ro_globals.clear()
+        return out
 
     def _globalize_feed(self, name, v):
         mesh = self.mesh
@@ -248,13 +258,23 @@ class ParallelExecutor(Executor):
         multiprocess = self._multiprocess
         step_sh = NamedSharding(mesh, P())
 
+        pending_ro = self._pending_ro_globals
+
         def call(feed_vals, state_vals, step):
             if multiprocess:
                 # state a plain Executor initialized (startup) lives on
                 # local devices; lift it to the global mesh once —
-                # thereafter the written-back state is already global
-                ro = {n: _globalize(state_vals[n], ro_shardings[n])
-                      for n in ro_names}
+                # thereafter the written-back state is already global.
+                # Read-only state is never written back, so its global
+                # form is handed to run() via _pending_ro_globals, which
+                # writes it into the RUN-TIME scope (one upload, not one
+                # per step; the compile-time scope may differ).
+                ro = {}
+                for n in ro_names:
+                    g = _globalize(state_vals[n], ro_shardings[n])
+                    if g is not state_vals[n]:
+                        pending_ro[n] = g
+                    ro[n] = g
                 rw = {n: _globalize(state_vals[n], rw_shardings[n])
                       for n in rw_names}
                 step = _globalize(step, step_sh)

@@ -407,3 +407,49 @@ def test_ingraph_select_mixed_send_recv_cases():
     assert int(np.asarray(iv)) == 1
     np.testing.assert_allclose(np.asarray(rv), 0.0)   # recv didn't fire
     np.testing.assert_allclose(np.asarray(gv), 4.0)   # send landed
+
+
+def test_ingraph_select_recv_ok_distinguishes_closed_channel():
+    """A recv case that fires with a genuine zero value reads ok=1; one
+    that fires because its channel CLOSED reads ok=0 (Go's
+    `v, ok := <-ch` — ADVICE r2: zeros alone are ambiguous)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    # genuine 0.0 value: ok == 1
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ch = layers.make_channel(capacity=1)
+        z = layers.fill_constant([1], "float32", 0.0)
+        layers.channel_send(ch, z)
+        idx, (r,), ok = layers.select(
+            [("recv", ch, [1], "float32")], return_ok=True)
+        layers.channel_close(ch)
+    exe = pt.Executor()
+    exe.run(startup)
+    iv, rv, okv = exe.run(main, fetch_list=[idx, r, ok])
+    assert int(np.asarray(iv)) == 0
+    np.testing.assert_allclose(np.asarray(rv), 0.0)
+    assert int(np.asarray(okv).reshape(-1)[0]) == 1
+
+    # closed channel: case fires, value is zeros, ok == 0. A host
+    # channel is used because the in-graph close unregisters a drained
+    # channel (close is its lifetime signal); a host-registered channel
+    # stays visible after close, like a Go channel var.
+    from paddle_tpu.concurrency import Channel
+    from paddle_tpu.ops.csp_ops import register_channel
+
+    host_ch = Channel(capacity=1)
+    host_ch.close()
+    cid = register_channel(host_ch)
+    main2, startup2 = pt.Program(), pt.Program()
+    with pt.program_guard(main2, startup2):
+        c = layers.fill_constant([], "int32", cid)
+        idx2, (r2,), ok2 = layers.select(
+            [("recv", c, [1], "float32")], return_ok=True)
+    exe2 = pt.Executor()
+    exe2.run(startup2)
+    iv2, rv2, okv2 = exe2.run(main2, fetch_list=[idx2, r2, ok2])
+    assert int(np.asarray(iv2)) == 0
+    np.testing.assert_allclose(np.asarray(rv2), 0.0)
+    assert int(np.asarray(okv2).reshape(-1)[0]) == 0

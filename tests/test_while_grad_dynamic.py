@@ -162,3 +162,34 @@ def test_two_dynamic_whiles_in_one_program():
     g_expect = 2 * 1.2 * 3 + 2 * 2.0 * 5
     x1 = float(np.asarray(pt.global_scope().get("xp2")).reshape(()))
     np.testing.assert_allclose((x0 - x1) / lr, g_expect, rtol=1e-4)
+
+
+def test_stateful_op_in_probe_prefix_raises():
+    """A channel/select/go op before a differentiated unbounded While
+    would be re-executed by the trip-count probe (firing twice per
+    step) — the executor must reject the combination explicitly rather
+    than silently desyncing the channel protocol."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.create_parameter(
+            shape=[1], dtype="float32", name="xp_st",
+            default_initializer=pt.initializer.ConstantInitializer(0.3))
+        ch = layers.make_channel(capacity=2)
+        v = layers.fill_constant([1], "float32", 1.0)
+        layers.channel_send(ch, v)          # stateful op in the prefix
+        thr = layers.data("thr_st", [1], dtype="float32")
+        s = layers.fill_constant([1], "float32", 0.0)
+        s.stop_gradient = False
+        cond = cf.less_than_v(s, thr)
+        w = cf.While(cond)
+        with w.block():
+            t = layers.elementwise_add(s, x)
+            layers.assign(t, output=s)
+            cf.less_than_v(s, thr, cond=cond)
+        loss = layers.reduce_sum(layers.square(s))
+        pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    with pytest.raises(RuntimeError, match="stateful"):
+        exe.run(main, feed={"thr_st": np.asarray([1.0], np.float32)},
+                fetch_list=[loss])
