@@ -83,3 +83,64 @@ def test_feed_cache_reuses_frozen_arrays():
     assert d1 is d2
     b = np.ones((4, 4), np.float32)  # writeable: must NOT be cached
     assert _to_device_value(b) is not _to_device_value(b)
+
+
+def test_bf16_convergence_parity_mnist():
+    """North-star clause "matching single-node accuracy": the SAME
+    BN-convnet, identically seeded and fed, trained to a fixed step
+    budget under f32 and under AMP bf16 must land at comparable loss
+    and eval accuracy (reference discipline:
+    python/paddle/fluid/tests/unittests/test_parallel_executor.py:194
+    check_network_convergence). The headline bench runs AMP bf16; this
+    pins that the bf16 path CONVERGES, not merely runs."""
+    from paddle_tpu import dataset, reader
+
+    steps, bs = 60, 64
+    batches = list(zip(range(steps + 1),
+                       reader.batch(dataset.mnist.train(), bs)()))
+    eval_imgs = np.stack([s[0] for _, b in batches[-1:] for s in b])
+    eval_labels = np.array([[s[1]] for _, b in batches[-1:] for s in b],
+                           np.int64)
+
+    results = {}
+    for amp_on in (False, True):
+        pt.reset_default_programs()
+        pt.reset_global_scope()
+        np.random.seed(7)
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            img = layers.data("img", [784], dtype="float32")
+            label = layers.data("label", [1], dtype="int64")
+            x = layers.reshape(img, [-1, 1, 28, 28])
+            x = layers.conv2d(x, num_filters=8, filter_size=5)
+            x = layers.batch_norm(x, act="relu")   # the custom-vjp BN
+            x = layers.pool2d(x, pool_size=2, pool_stride=2)
+            logits = layers.fc(x, size=10)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, label))
+            acc = layers.accuracy(layers.softmax(logits), label)
+            pt.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(loss)
+        exe = pt.Executor()
+        exe.run(startup)
+        losses = []
+        with pt.amp.amp_guard(amp_on):
+            for _, b in batches[:steps]:
+                feed = {"img": np.stack([s[0] for s in b]),
+                        "label": np.array([[s[1]] for s in b], np.int64)}
+                (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(lv)))
+            (accv,) = exe.run(main, feed={"img": eval_imgs,
+                                          "label": eval_labels},
+                              fetch_list=[acc])
+        assert all(np.isfinite(l) for l in losses)
+        results[amp_on] = (float(np.mean(losses[-10:])),
+                           float(np.asarray(accv)))
+
+    f32_loss, f32_acc = results[False]
+    bf16_loss, bf16_acc = results[True]
+    # both must have genuinely converged...
+    assert f32_loss < 0.6 * np.log(10) and bf16_loss < 0.6 * np.log(10)
+    # ...and agree: bf16 keeps f32's exponent range, so the curves track
+    # within bf16's ~3-digit mantissa noise at this scale
+    assert abs(bf16_loss - f32_loss) < 0.10 + 0.15 * f32_loss, results
+    assert abs(bf16_acc - f32_acc) < 0.08, results
