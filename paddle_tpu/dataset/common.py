@@ -36,3 +36,34 @@ def rng_for(name: str, split: str) -> np.random.RandomState:
     seed = int.from_bytes(hashlib.sha256(
         f"{name}:{split}".encode()).digest()[:4], "little")
     return np.random.RandomState(seed)
+
+
+_FREQ_DICT_CACHE: dict = {}
+
+
+def build_freq_dict(docs_fn, cache_key, cutoff: int = 1,
+                    leading=(), cap=None, unk="<unk>"):
+    """Shared corpus-vocabulary builder (reference: the per-dataset
+    build_dict functions in python/paddle/dataset/{imdb,imikolov,
+    wmt16}.py all follow this shape): count words over `docs_fn()`
+    (an iterable of token lists), keep those with count >= cutoff
+    ranked by (-count, word), prefix `leading` specials, cap total size
+    at `cap`, and append `unk` if not already present. Memoized by
+    `cache_key` — readers rebuild their dicts every epoch, and a corpus
+    scan is the expensive part."""
+    if cache_key in _FREQ_DICT_CACHE:
+        return _FREQ_DICT_CACHE[cache_key]
+    freq: dict = {}
+    for words in docs_fn():
+        for w in words:
+            freq[w] = freq.get(w, 0) + 1
+    ranked = sorted(((w, c) for w, c in freq.items() if c >= cutoff),
+                    key=lambda kv: (-kv[1], kv[0]))
+    words = list(leading) + [w for w, _c in ranked]
+    if cap is not None:
+        words = words[:cap]
+    d = {w: i for i, w in enumerate(words)}
+    if unk is not None and unk not in d:
+        d[unk] = len(d)
+    _FREQ_DICT_CACHE[cache_key] = d
+    return d
