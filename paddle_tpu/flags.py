@@ -76,6 +76,12 @@ FLAGS: Dict[str, tuple] = {
     "BENCH_REPEATS": ("2", "bench.py",
                       "repeat the headline marginal measurement and "
                       "report median + spread"),
+    "PADDLE_TPU_BN_CUSTOM_VJP": (
+        "0", "ops/nn_ops.py",
+        "use the round-2 hand-written BatchNorm backward (custom_vjp) "
+        "instead of autodiff; the autodiff default lets XLA fuse the "
+        "backward reductions into conv gradient fusions — see "
+        "MFU_BREAKDOWN.md round 3"),
 }
 
 

@@ -37,6 +37,16 @@ def _conv_nhwc():
 
 
 def _conv2d_impl(x, w, strides, paddings, dilations, groups):
+    # A strided 1x1 conv only READS the subsampled grid: slicing first
+    # and convolving stride-1 is the same math, but its transpose
+    # (weight/input grads) lowers to clean MXU matmuls + a pad, where
+    # the strided form's gradients lowered to ~0.5ms/conv loop fusions
+    # (copy_subtract in the device trace — the round-2 "stride-2
+    # gradient fringe"). ResNet's downsample shortcuts hit this.
+    if (tuple(w.shape[2:]) == (1, 1) and tuple(paddings) == (0, 0)
+            and (strides[0] > 1 or strides[1] > 1) and groups == 1):
+        x = x[:, :, ::strides[0], ::strides[1]]
+        strides = (1, 1)
     # Under AMP both operands drop to bf16 and the OUTPUT STAYS bf16:
     # activations thread end-to-end at half width so every inter-op HBM
     # buffer halves. (Round 1 cast each op's result back to f32; device
@@ -189,19 +199,33 @@ def _bn_bshape(x, ch_axis):
     return tuple(bshape)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _bn_train(x, scale, bias, red_axes, eps):
+    """Train-mode BN forward, LEFT TO AUTODIFF on purpose (round 3):
+    traced on TPU, XLA fuses the single-pass stats and the coefficient
+    normalize into the producing convolution's fusion, and — decisive —
+    it also fuses the autodiffed backward reductions into the conv
+    gradient fusions. The round-2 hand-written custom_vjp backward
+    (kept below as _bn_train_custom for the A/B) pinned those
+    reductions as standalone convert_reduce fusions: the device trace
+    showed 64 of them costing ~30ms/step vs ~0 for this form."""
     (y, _m, _v), _res = _bn_train_fwd(x, scale, bias, red_axes, eps)
     return y
 
 
+# round-2 variant: same forward under a custom_vjp with the
+# hand-derived 2-pass backward. Superseded as the default (see
+# _bn_train) but kept selectable for A/Bs via PADDLE_TPU_BN_CUSTOM_VJP.
+_bn_train_custom = functools.partial(jax.custom_vjp,
+                                     nondiff_argnums=(3, 4))(_bn_train)
+
+
 def _bn_train_fwd(x, scale, bias, red_axes, eps):
     """Single-pass stats (sum / sum-of-squares fuse into ONE sweep over
-    x) + a coefficient-form normalize (y = x*a + b with per-channel a,b)
-    so the forward touches x exactly twice. The device trace showed the
-    autodiffed mean->var->normalize chain costing ~35% of the ResNet-50
-    step (MFU_BREAKDOWN.md); this plus the hand-derived 2-pass backward
-    halves BN's HBM traffic."""
+    x) + a coefficient-form normalize (y = x*a + b with per-channel
+    a,b). Written this way so XLA can fuse both the stats and the
+    normalize into the producing conv's fusion — and, under autodiff
+    (the default path), the backward reductions into the conv gradient
+    fusions; see _bn_train."""
     ch_axis = [i for i in range(x.ndim) if i not in red_axes][0]
     bshape = _bn_bshape(x, ch_axis)
     n = 1
@@ -244,7 +268,7 @@ def _bn_train_vjp_fwd(x, scale, bias, red_axes, eps):
     return y, res
 
 
-_bn_train.defvjp(_bn_train_vjp_fwd, _bn_train_bwd)
+_bn_train_custom.defvjp(_bn_train_vjp_fwd, _bn_train_bwd)
 
 
 @register_op("batch_norm")
@@ -277,7 +301,10 @@ def _batch_norm(ctx):
         ctx.set_output("SavedVariance", var_in)
         return
 
-    y = _bn_train(x, scale, bias, red_axes, eps)
+    if os.environ.get("PADDLE_TPU_BN_CUSTOM_VJP", "0") == "1":
+        y = _bn_train_custom(x, scale, bias, red_axes, eps)  # round-2 A/B
+    else:
+        y = _bn_train(x, scale, bias, red_axes, eps)
     # stats recomputed OUTSIDE the custom_vjp so running-stat updates
     # carry no gradient plumbing; XLA CSEs them with the fwd pass sums
     xf = x.astype(jnp.float32)
