@@ -249,24 +249,40 @@ class _BlockPrefix:
 
 
 def _dynamic_while_targets(block: BlockDesc):
-    """(while_id, steps_var_name) for every unbounded While this block
-    differentiates (a __vjp__ grad op replays it), plus the index one
-    past the last such forward While op — the probe prefix length."""
-    ids = set()
+    """{while_id: steps_var_name} for every unbounded While a __vjp__
+    grad op replays — directly, or NESTED inside a replayed While /
+    DynamicRNN / StaticRNN (their ops max-accumulate nested trip counts
+    into NestedSteps outputs; reference analog: while_op.cc:96 step
+    scopes nest freely) — plus the index one past the last such forward
+    op, the probe prefix length."""
+    def op_key(t, attrs):
+        if t == "while":
+            return ("while", attrs.get("while_id"))
+        if t in ("dynamic_rnn", "static_rnn"):
+            return (t, attrs.get("sub_block_idx"))
+        if t == "cond":
+            return ("cond", attrs.get("true_block_idx"),
+                    attrs.get("false_block_idx"))
+        return None
+
+    grad_keys = set()
     for op in block.ops:
         if op.type != "__vjp__":
             continue
         fwd = op.attrs.get("fwd_op") or {}
-        if fwd.get("type") != "while":
-            continue
-        a = fwd.get("attrs") or {}
-        if int(a.get("max_steps", 0) or 0) <= 0 and a.get("dynamic_bound"):
-            ids.add(a.get("while_id"))
-    if not ids:
+        key = op_key(fwd.get("type"), fwd.get("attrs") or {})
+        if key is not None:
+            grad_keys.add(key)
+    if not grad_keys:
         return {}, 0
     targets, prefix = {}, 0
     for i, op in enumerate(block.ops):
-        if op.type == "while" and op.attrs.get("while_id") in ids:
+        key = op_key(op.type, op.attrs)
+        if key is None or key not in grad_keys:
+            continue
+        found = False
+        if op.type == "while" and op.attrs.get("dynamic_bound") and \
+                int(op.attrs.get("max_steps", 0) or 0) <= 0:
             steps = op.outputs.get("Steps")
             if not steps:
                 raise RuntimeError(
@@ -274,6 +290,18 @@ def _dynamic_while_targets(block: BlockDesc):
                     "Steps output — rebuild the program with the "
                     "current While layer")
             targets[op.attrs["while_id"]] = steps[0]
+            found = True
+        nested = op.attrs.get("nested_while_ids") or []
+        if nested:
+            ns_vars = op.outputs.get("NestedSteps") or []
+            if len(ns_vars) != len(nested):
+                raise RuntimeError(
+                    f"{op.type} op has nested dynamic Whiles {nested} "
+                    "but no matching NestedSteps outputs — rebuild the "
+                    "program with the current control-flow layers")
+            targets.update(zip(nested, ns_vars))
+            found = True
+        if found:
             prefix = i + 1
     return targets, prefix
 

@@ -112,16 +112,19 @@ class StaticRNN:
         helper = self.helper
         self._result_vars = [
             helper.create_tmp_variable(o.dtype) for o in self._outputs]
+        outputs = {"Out": self._result_vars}
+        attrs = {"sub_block_idx": self._block.idx,
+                 "step_in_names": [sv.name for _, sv in self._inputs],
+                 "mem_pre_names": [v.name for v in self._mem_pre],
+                 "mem_new_names": [v.name for v in self._mem_new],
+                 "out_names": [o.name for o in self._outputs]}
+        _wire_nested_steps(helper, self._parent_prog, self._block,
+                           outputs, attrs)
         helper.append_op(
             type="static_rnn",
             inputs={"X": [x for x, _ in self._inputs],
                     "MemInit": self._mem_init},
-            outputs={"Out": self._result_vars},
-            attrs={"sub_block_idx": self._block.idx,
-                   "step_in_names": [sv.name for _, sv in self._inputs],
-                   "mem_pre_names": [v.name for v in self._mem_pre],
-                   "mem_new_names": [v.name for v in self._mem_new],
-                   "out_names": [o.name for o in self._outputs]})
+            outputs=outputs, attrs=attrs)
 
     def __call__(self):
         res = self._result_vars
@@ -240,17 +243,20 @@ class DynamicRNN:
             helper.create_tmp_variable(m.dtype, shape=list(m.shape)
                                        if m.shape else None)
             for m in self._mem_init]
+        outputs = {"Out": self._result_vars,
+                   "LastMem": self._last_mem_vars}
+        attrs = {"sub_block_idx": self._block.idx,
+                 "step_in_names": [sv.name for _, sv in self._inputs],
+                 "mem_pre_names": [v.name for v in self._mem_pre],
+                 "mem_new_names": [v.name for v in self._mem_new],
+                 "out_names": [o.name for o in self._outputs]}
+        _wire_nested_steps(helper, self._parent_prog, self._block,
+                           outputs, attrs)
         helper.append_op(
             type="dynamic_rnn",
             inputs={"X": [x for x, _ in self._inputs],
                     "MemInit": self._mem_init},
-            outputs={"Out": self._result_vars,
-                     "LastMem": self._last_mem_vars},
-            attrs={"sub_block_idx": self._block.idx,
-                   "step_in_names": [sv.name for _, sv in self._inputs],
-                   "mem_pre_names": [v.name for v in self._mem_pre],
-                   "mem_new_names": [v.name for v in self._mem_new],
-                   "out_names": [o.name for o in self._outputs]})
+            outputs=outputs, attrs=attrs)
 
     def __call__(self):
         res = self._result_vars
@@ -340,6 +346,29 @@ class IfElse:
         return res[0] if len(res) == 1 else res
 
 
+def _wire_nested_steps(helper, prog, blk, outputs, attrs):
+    """Dynamic (unbounded) Whiles nested anywhere under `blk` get one
+    parent-block int32 var each, wired as the enclosing op's
+    NestedSteps outputs: the op max-accumulates every nested loop's
+    per-iteration trip count into them, and the executor's
+    probe-and-replay WhileGrad reads them to bake one static bound per
+    nesting level (reference: while_op.cc:96 step scopes, which nest
+    freely). The wid order comes from the SAME traversal the op-side
+    lowering uses (ops/control_flow_ops.nested_dynamic_wids) — the
+    executor zips these vars with that list, so a single source of
+    truth keeps them aligned."""
+    from ..ops.control_flow_ops import nested_dynamic_wids
+    wids = nested_dynamic_wids(prog.desc, blk.desc.idx)
+    if wids:
+        step_vars = [
+            helper.create_variable(
+                name=f"{helper.name}.nested_steps.{i}", dtype="int32",
+                shape=[], stop_gradient=True)
+            for i in range(len(wids))]
+        outputs["NestedSteps"] = [v.name for v in step_vars]
+        attrs["nested_while_ids"] = wids
+
+
 class While:
     """While loop over a boolean condition var (reference:
     control_flow.py:608 / while_op.cc). Loop-carried state is every var
@@ -404,15 +433,16 @@ class While:
             name=f"{self.helper.name}.steps", dtype="int32",
             shape=[], stop_gradient=True)
         outputs["Steps"] = [self.steps.name]
+        attrs = {"sub_block_idx": blk.idx,
+                 "carried_names": written,
+                 "cond_name": self.cond_var.name,
+                 "max_steps": int(self.max_steps or 0),
+                 "while_id": self.helper.name,
+                 "dynamic_bound": self.max_steps is None}
+        _wire_nested_steps(self.helper, self._prog, blk, outputs, attrs)
         self.helper.append_op(
             type="while", inputs={"Cond": self.cond_var},
-            outputs=outputs,
-            attrs={"sub_block_idx": blk.idx,
-                   "carried_names": written,
-                   "cond_name": self.cond_var.name,
-                   "max_steps": int(self.max_steps or 0),
-                   "while_id": self.helper.name,
-                   "dynamic_bound": self.max_steps is None})
+            outputs=outputs, attrs=attrs)
 
 
 class Switch:
@@ -502,13 +532,29 @@ def cond_op(pred, true_fn, false_fn):
     prog.rollback()
 
     out = helper.create_tmp_variable(true_out.dtype)
-    helper.append_op(type="cond",
-                     inputs={"Pred": pred},
-                     outputs={"Out": out},
-                     attrs={"true_block_idx": tb.idx,
-                            "false_block_idx": fb.idx,
-                            "true_out": true_out.name,
-                            "false_out": false_out.name})
+    outputs = {"Out": out}
+    attrs = {"true_block_idx": tb.idx,
+             "false_block_idx": fb.idx,
+             "true_out": true_out.name,
+             "false_out": false_out.name}
+    # dynamic Whiles in either branch surface their trip counts, in the
+    # same union order the op-side lowering computes
+    from ..ops.control_flow_ops import nested_dynamic_wids
+    wids = []
+    for b in (tb.idx, fb.idx):
+        for w in nested_dynamic_wids(prog.desc, b):
+            if w not in wids:
+                wids.append(w)
+    if wids:
+        step_vars = [
+            helper.create_variable(
+                name=f"{helper.name}.nested_steps.{i}", dtype="int32",
+                shape=[], stop_gradient=True)
+            for i in range(len(wids))]
+        outputs["NestedSteps"] = [v.name for v in step_vars]
+        attrs["nested_while_ids"] = wids
+    helper.append_op(type="cond", inputs={"Pred": pred},
+                     outputs=outputs, attrs=attrs)
     return out
 
 

@@ -26,6 +26,56 @@ def _trace_sub(ctx, block_idx, env):
     return trace_block(prog.blocks[block_idx], env, ctx.extra)
 
 
+def nested_dynamic_wids(program, blk_idx):
+    """while_ids of every unbounded (dynamic_bound) While nested
+    anywhere under block `blk_idx`, in deterministic program order.
+    Static program structure — safe to bake into carry shapes."""
+    out = []
+
+    def visit(bi):
+        for op in program.blocks[bi].ops:
+            if op.type == "while" and op.attrs.get("dynamic_bound") and \
+                    int(op.attrs.get("max_steps", 0) or 0) <= 0:
+                out.append(op.attrs.get("while_id"))
+            for attr in ("sub_block_idx", "true_block_idx",
+                         "false_block_idx"):
+                idx = op.attrs.get(attr)
+                if isinstance(idx, int):
+                    visit(idx)
+
+    visit(blk_idx)
+    return out
+
+
+def _collect_reports(ctx, trace_fn):
+    """Run `trace_fn()` with a fresh nested-steps report dict in
+    ctx.extra; returns (trace result, {wid: steps tracer}) reported by
+    dynamic Whiles lowered inside it. The probe-and-replay WhileGrad
+    measures NESTED loops this way: each level max-accumulates its
+    children's per-iteration trip counts in its own carry (reference
+    analog: while_op.cc:96 step scopes nest freely)."""
+    extra = ctx.extra
+    saved = extra.get("nested_steps_report")
+    extra["nested_steps_report"] = {}
+    try:
+        result = trace_fn()
+        rep = extra["nested_steps_report"]
+    finally:
+        extra["nested_steps_report"] = saved
+    return result, rep
+
+
+def _publish_report(ctx, entries):
+    """Report {wid: steps} to an enclosing collector, if any."""
+    rep = ctx.extra.get("nested_steps_report")
+    if rep is not None:
+        rep.update(entries)
+
+
+def _zero_steps():
+    return jnp.zeros((), jnp.int32)
+
+
 @register_op_CF("static_rnn")
 def _static_rnn(ctx):
     """Scan over leading time axis of each step input."""
@@ -37,19 +87,29 @@ def _static_rnn(ctx):
     out_names = ctx.attr("out_names")
     blk_idx = ctx.attr("sub_block_idx")
     outer = dict(ctx.env)
+    nested = nested_dynamic_wids(ctx.extra["program"], blk_idx)
 
-    def body(carry, x_t):
-        env = dict(outer)
-        env.update(zip(mem_pre, carry))
-        env.update(zip(step_in, x_t))
-        env = _trace_sub(ctx, blk_idx, env)
+    def body(state, x_t):
+        carry, maxes = state
+
+        def trace():
+            env = dict(outer)
+            env.update(zip(mem_pre, carry))
+            env.update(zip(step_in, x_t))
+            return _trace_sub(ctx, blk_idx, env)
+
+        env, rep = _collect_reports(ctx, trace)
+        maxes = tuple(jnp.maximum(m, rep.get(w, _zero_steps()))
+                      for w, m in zip(nested, maxes))
         new_carry = tuple(env[n] for n in mem_new)
         outs = tuple(env[n] for n in out_names)
-        return new_carry, outs
+        return (new_carry, maxes), outs
 
-    carry0 = tuple(mem_init)
-    _, stacked = jax.lax.scan(body, carry0, tuple(xs))
+    state0 = (tuple(mem_init), tuple(_zero_steps() for _ in nested))
+    (_, maxes), stacked = jax.lax.scan(body, state0, tuple(xs))
     ctx.set_outputs("Out", list(stacked))
+    ctx.set_outputs("NestedSteps", list(maxes))
+    _publish_report(ctx, dict(zip(nested, maxes)))
 
 
 @register_op_CF("while")
@@ -80,6 +140,11 @@ def _while(ctx):
     outer = dict(ctx.env)
     cond0 = ctx.input("Cond")
     init = tuple(outer[n] for n in carried)
+    wid = ctx.attr("while_id")
+    # dynamic Whiles nested anywhere below: their per-iteration trip
+    # counts are max-accumulated through this loop's carry so the
+    # executor's probe can read one static bound per nesting level
+    nested = nested_dynamic_wids(ctx.extra["program"], blk_idx)
 
     def body_env(vals):
         env = dict(outer)
@@ -88,21 +153,32 @@ def _while(ctx):
         return (env[cond_name].reshape(()).astype(jnp.bool_),
                 tuple(env[n] for n in carried))
 
+    def body_with_reports(vals, maxes):
+        (new_cond, new_vals), rep = _collect_reports(
+            ctx, lambda: body_env(vals))
+        new_maxes = tuple(jnp.maximum(m, rep.get(w, _zero_steps()))
+                          for w, m in zip(nested, maxes))
+        return new_cond, new_vals, new_maxes
+
+    maxes0 = tuple(_zero_steps() for _ in nested)
+
     if max_steps > 0:
         def scan_body(state, _):
-            active, count, vals = state
-            new_cond, new_vals = body_env(vals)
+            active, count, maxes, vals = state
+            new_cond, new_vals, new_maxes = body_with_reports(vals, maxes)
             # carries may be pytrees (e.g. RaggedPair): select per leaf
             kept = tuple(
                 jax.tree_util.tree_map(
                     lambda a, b: jnp.where(active, a, b), n, o)
                 for n, o in zip(new_vals, vals))
+            new_maxes = tuple(jnp.where(active, nm, m)
+                              for nm, m in zip(new_maxes, maxes))
             count = count + active.astype(jnp.int32)
-            return (active & new_cond, count, kept), None
+            return (active & new_cond, count, new_maxes, kept), None
 
         state0 = (cond0.reshape(()).astype(jnp.bool_),
-                  jnp.zeros((), jnp.int32), init)
-        (still_active, count, final_vals), _ = jax.lax.scan(
+                  jnp.zeros((), jnp.int32), maxes0, init)
+        (still_active, count, maxes, final_vals), _ = jax.lax.scan(
             scan_body, state0, None, length=max_steps)
         ctx.set_outputs("Out", list(final_vals))
         # still true after max_steps iterations => the loop was truncated
@@ -110,42 +186,65 @@ def _while(ctx):
         # an optional output the layer wires to `<name>.exhausted`
         ctx.set_output("Exhausted", still_active)
         ctx.set_output("Steps", count)
+        ctx.set_outputs("NestedSteps", list(maxes))
+        _publish_report(ctx, dict(zip(nested, maxes)))
         return
 
     def cond_fn(state):
         return state[0].reshape(())
 
     def body_fn(state):
-        new_cond, new_vals = body_env(state[2:])
-        return (new_cond, state[1] + 1) + new_vals
+        maxes = state[2:2 + len(nested)]
+        new_cond, new_vals, new_maxes = body_with_reports(
+            state[2 + len(nested):], maxes)
+        return (new_cond, state[1] + 1) + new_maxes + new_vals
 
     final = jax.lax.while_loop(
         cond_fn, body_fn,
         (cond0.reshape(()).astype(jnp.bool_), jnp.zeros((), jnp.int32))
-        + init)
-    ctx.set_outputs("Out", list(final[2:]))
-    ctx.set_output("Steps", final[1])
+        + maxes0 + init)
+    steps = final[1]
+    maxes = final[2:2 + len(nested)]
+    ctx.set_outputs("Out", list(final[2 + len(nested):]))
+    ctx.set_output("Steps", steps)
+    ctx.set_outputs("NestedSteps", list(maxes))
+    # visible to an enclosing collector: own trip count + children's
+    _publish_report(ctx, {wid: steps, **dict(zip(nested, maxes))})
 
 
 @register_op_CF("cond")
 def _cond(ctx):
     pred = ctx.input("Pred")
     outer = dict(ctx.env)
+    prog = ctx.extra["program"]
+    tb = ctx.attr("true_block_idx")
+    fb = ctx.attr("false_block_idx")
+    # dynamic Whiles inside either branch report their trip counts as
+    # extra lax.cond outputs — a tracer may not leak from a branch
+    # trace into an enclosing collector directly (the untaken branch
+    # contributes zeros, which can only under-report; the probe only
+    # needs counts for what actually EXECUTED)
+    wids = []
+    for b in (tb, fb):
+        for w in nested_dynamic_wids(prog, b):
+            if w not in wids:
+                wids.append(w)
 
     def make_branch(blk_idx, out_name):
         def branch(_):
-            env = dict(outer)
-            env = _trace_sub(ctx, blk_idx, env)
-            return env[out_name]
+            env, rep = _collect_reports(
+                ctx, lambda: _trace_sub(ctx, blk_idx, dict(outer)))
+            return (env[out_name],) + tuple(
+                rep.get(w, _zero_steps()) for w in wids)
         return branch
 
-    out = jax.lax.cond(pred.reshape(()).astype(jnp.bool_),
-                       make_branch(ctx.attr("true_block_idx"),
-                                   ctx.attr("true_out")),
-                       make_branch(ctx.attr("false_block_idx"),
-                                   ctx.attr("false_out")),
+    res = jax.lax.cond(pred.reshape(()).astype(jnp.bool_),
+                       make_branch(tb, ctx.attr("true_out")),
+                       make_branch(fb, ctx.attr("false_out")),
                        operand=None)
-    ctx.set_output("Out", out)
+    ctx.set_output("Out", res[0])
+    ctx.set_outputs("NestedSteps", list(res[1:]))
+    _publish_report(ctx, dict(zip(wids, res[1:])))
 
 
 # -- tensor arrays (dense fixed-capacity form) ------------------------------
@@ -212,13 +311,22 @@ def _dynamic_rnn(ctx):
     # time-major step data for scan
     xs_tm = tuple(jnp.moveaxis(r.data, 1, 0) for r in rags)
 
-    def body(carry, inp):
+    nested = nested_dynamic_wids(ctx.extra["program"], blk_idx)
+
+    def body(state, inp):
+        carry, maxes = state
         t, x_t = inp
         active = (t < lengths)           # [B]
-        env = dict(outer)
-        env.update(zip(mem_pre, carry))
-        env.update(zip(step_in, x_t))
-        env = _trace_sub(ctx, blk_idx, env)
+
+        def trace():
+            env = dict(outer)
+            env.update(zip(mem_pre, carry))
+            env.update(zip(step_in, x_t))
+            return _trace_sub(ctx, blk_idx, env)
+
+        env, rep = _collect_reports(ctx, trace)
+        maxes = tuple(jnp.maximum(m, rep.get(w, _zero_steps()))
+                      for w, m in zip(nested, maxes))
         new_carry = []
         for old, name in zip(carry, mem_new):
             new = env[name]
@@ -229,13 +337,16 @@ def _dynamic_rnn(ctx):
             o = env[n]
             m = active.reshape((-1,) + (1,) * (o.ndim - 1))
             outs.append(jnp.where(m, o, jnp.zeros_like(o)))
-        return tuple(new_carry), tuple(outs)
+        return (tuple(new_carry), maxes), tuple(outs)
 
     ts = jnp.arange(t_max, dtype=jnp.int32)
-    final_mems, stacked = jax.lax.scan(body, tuple(mem_init), (ts, xs_tm))
+    state0 = (tuple(mem_init), tuple(_zero_steps() for _ in nested))
+    (final_mems, maxes), stacked = jax.lax.scan(body, state0, (ts, xs_tm))
     outs = [RaggedPair(jnp.moveaxis(s, 0, 1), lengths) for s in stacked]
     ctx.set_outputs("Out", outs)
     ctx.set_outputs("LastMem", list(final_mems))
+    ctx.set_outputs("NestedSteps", list(maxes))
+    _publish_report(ctx, dict(zip(nested, maxes)))
 
 
 @register_op_CF("if_else")

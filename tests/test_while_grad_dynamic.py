@@ -193,3 +193,198 @@ def test_stateful_op_in_probe_prefix_raises():
     with pytest.raises(RuntimeError, match="stateful"):
         exe.run(main, feed={"thr_st": np.asarray([1.0], np.float32)},
                 fetch_list=[loss])
+
+
+def test_nested_dynamic_while_gradient_matches_finite_differences():
+    """A dynamic-trip-count While NESTED inside another dynamic While
+    trains (VERDICT r3 item 3): the outer loop max-accumulates the
+    inner loop's per-iteration trip count into its NestedSteps output,
+    the probe reads one bound per nesting level, and the program
+    recompiles as nested masked scans (reference: while_op.cc:96-109
+    step scopes, which nest freely)."""
+    lr, x0, target = 0.05, 0.3, 2.0
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.create_parameter(
+            shape=[1], dtype="float32", name="xp_nest",
+            default_initializer=pt.initializer.ConstantInitializer(x0))
+        thr_out = layers.data("thr_out", [1], dtype="float32")
+        thr_in = layers.data("thr_in", [1], dtype="float32")
+        s = layers.fill_constant([1], "float32", 0.0)
+        s.stop_gradient = False
+        cond_o = cf.less_than_v(s, thr_out)
+        w_o = cf.While(cond_o)
+        with w_o.block():
+            t = layers.fill_constant([1], "float32", 0.0)
+            t.stop_gradient = False
+            cond_i = cf.less_than_v(t, thr_in)
+            w_i = cf.While(cond_i)          # NO max_steps, nested
+            with w_i.block():
+                layers.assign(layers.elementwise_add(t, x), output=t)
+                cf.less_than_v(t, thr_in, cond=cond_i)
+            layers.assign(layers.elementwise_add(s, t), output=s)
+            cf.less_than_v(s, thr_out, cond=cond_o)
+        tgt = layers.fill_constant([1], "float32", target)
+        loss = layers.reduce_sum(layers.square(layers.elementwise_sub(
+            s, tgt)))
+        pt.optimizer.SGDOptimizer(learning_rate=lr).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+
+    def host(x, to, ti):
+        s = 0.0
+        n_out = 0
+        while s < to:
+            t = 0.0
+            while t < ti:
+                t += x
+            s += t
+            n_out += 1
+        return (s - target) ** 2, n_out
+
+    to, ti = 2.0, 1.0
+    lv, n_out = exe.run(
+        main, feed={"thr_out": np.asarray([to], np.float32),
+                    "thr_in": np.asarray([ti], np.float32)},
+        fetch_list=[loss, w_o.steps])
+    # x=0.3: inner 4 steps -> t=1.2; outer: 1.2, 2.4 -> 2 iterations
+    assert int(np.asarray(n_out)) == 2
+    np.testing.assert_allclose(float(np.asarray(lv)),
+                               (2.4 - target) ** 2, rtol=1e-5)
+    x1 = float(np.asarray(pt.global_scope().get("xp_nest")).reshape(()))
+    eps = 1e-3
+    fp, _ = host(x0 + eps, to, ti)
+    fm, _ = host(x0 - eps, to, ti)
+    g_fd = (fp - fm) / (2 * eps)
+    np.testing.assert_allclose((x0 - x1) / lr, g_fd, rtol=1e-3)
+    # analytic: s = n_out*n_in*x -> dloss/dx = 2*(s-target)*n_out*n_in
+    np.testing.assert_allclose((x0 - x1) / lr, 2 * 0.4 * 8, rtol=1e-4)
+
+
+def test_dynamic_while_inside_dynamic_rnn_trains():
+    """A dynamic While inside a DynamicRNN step block: the RNN's scan
+    max-accumulates the inner trip count (NestedSteps) and the whole
+    construct is differentiable after probe-and-replay."""
+    from paddle_tpu.core.lod import LoDTensor
+
+    lr, p0 = 0.02, 0.25
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        p = layers.create_parameter(
+            shape=[1], dtype="float32", name="p_drnn_nest",
+            default_initializer=pt.initializer.ConstantInitializer(p0))
+        x = layers.data("x", [1], dtype="float32", lod_level=1)
+        drnn = cf.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x)        # [1, 1] (batch 1)
+            prev = drnn.memory(shape=[1], value=0.0)
+            # inner: walk t up by p until it reaches this step's x_t
+            t = layers.fill_constant([1], "float32", 0.0)
+            t.stop_gradient = False
+            thr = layers.reshape(x_t, [1])
+            cond_i = cf.less_than_v(t, thr)
+            w_i = cf.While(cond_i)
+            with w_i.block():
+                layers.assign(layers.elementwise_add(t, p), output=t)
+                cf.less_than_v(t, thr, cond=cond_i)
+            nxt = layers.elementwise_add(prev, layers.reshape(t, [1, 1]))
+            drnn.update_memory(prev, nxt)
+            drnn.output(nxt)
+        _ = drnn()
+        last = drnn.last_memory()
+        loss = layers.reduce_sum(layers.square(last))
+        pt.optimizer.SGDOptimizer(learning_rate=lr).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+
+    seq = np.asarray([[0.4], [0.9], [0.2]], np.float32)   # one sequence
+    rag = LoDTensor.from_sequences([seq])
+    (lv,) = exe.run(main, feed={"x": rag}, fetch_list=[loss])
+
+    def host(p):
+        s = 0.0
+        for xt in (0.4, 0.9, 0.2):
+            t = 0.0
+            while t < xt:
+                t += p
+            s += t
+        return s * s
+
+    np.testing.assert_allclose(float(np.asarray(lv)), host(p0),
+                               rtol=1e-5)
+    p1 = float(np.asarray(pt.global_scope().get("p_drnn_nest"))
+               .reshape(()))
+    eps = 1e-3
+    g_fd = (host(p0 + eps) - host(p0 - eps)) / (2 * eps)
+    np.testing.assert_allclose((p0 - p1) / lr, g_fd, rtol=1e-3)
+
+
+def test_dynamic_while_inside_cond_branch():
+    """A dynamic While inside a lax.cond branch (itself inside an outer
+    dynamic While) must run AND train: branch trip counts surface as
+    extra cond outputs (a tracer may not leak from a branch trace), so
+    the outer loop's max-accumulation and the probe see them."""
+    lr, x0 = 0.001, 0.3
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.create_parameter(
+            shape=[1], dtype="float32", name="xp_cond",
+            default_initializer=pt.initializer.ConstantInitializer(x0))
+        thr_out = layers.data("thr_out", [1], dtype="float32")
+        thr_in = layers.data("thr_in", [1], dtype="float32")
+        s = layers.fill_constant([1], "float32", 0.0)
+        s.stop_gradient = False
+        cond_o = cf.less_than_v(s, thr_out)
+        w_o = cf.While(cond_o)
+        with w_o.block():
+            half = layers.fill_constant([1], "float32", 0.6)
+            pred = cf.less_than_v(s, half)   # branch varies by iteration
+
+            def walk():
+                # dynamic inner While lives in the TRUE branch only
+                t = layers.fill_constant([1], "float32", 0.0)
+                t.stop_gradient = False
+                cond_i = cf.less_than_v(t, thr_in)
+                w_i = cf.While(cond_i)
+                with w_i.block():
+                    layers.assign(layers.elementwise_add(t, x), output=t)
+                    cf.less_than_v(t, thr_in, cond=cond_i)
+                return t
+
+            def fixed():
+                return layers.scale(x, scale=2.0)
+
+            inc = cf.cond_op(pred, walk, fixed)
+            layers.assign(layers.elementwise_add(s, inc), output=s)
+            cf.less_than_v(s, thr_out, cond=cond_o)
+        loss = layers.reduce_sum(layers.square(s))
+        pt.optimizer.SGDOptimizer(learning_rate=lr).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+
+    def host(xv, to, ti):
+        s = 0.0
+        while s < to:
+            if s < 0.6:
+                t = 0.0
+                while t < ti:
+                    t += xv
+                s += t
+            else:
+                s += 2 * xv
+        return s * s
+
+    to, ti = 1.5, 1.0
+    # x=0.3: iter1 s<0.6 -> inner walks to 1.2, s=1.2; iter2 s>=0.6 ->
+    # s=1.8 >= 1.5 -> 2 outer iterations
+    lv, n = exe.run(main,
+                    feed={"thr_out": np.asarray([to], np.float32),
+                          "thr_in": np.asarray([ti], np.float32)},
+                    fetch_list=[loss, w_o.steps])
+    assert int(np.asarray(n)) == 2
+    np.testing.assert_allclose(float(np.asarray(lv)), host(x0, to, ti),
+                               rtol=1e-5)
+    x1 = float(np.asarray(pt.global_scope().get("xp_cond")).reshape(()))
+    eps = 1e-3
+    g_fd = (host(x0 + eps, to, ti) - host(x0 - eps, to, ti)) / (2 * eps)
+    np.testing.assert_allclose((x0 - x1) / lr, g_fd, rtol=1e-3)
