@@ -53,7 +53,7 @@ WARMUP = int(os.environ.get("BENCH_WARMUP", "3"))
 N1 = int(os.environ.get("BENCH_N1", "5"))
 N2 = int(os.environ.get("BENCH_N2", "25"))
 RUN_EXTRAS = os.environ.get("BENCH_EXTRAS", "1") == "1"
-# headline metric repeats (median + spread); extras stay single-shot
+# repeats for the headline AND the extras (median + spread reported)
 REPEATS = int(os.environ.get("BENCH_REPEATS", "2"))
 
 
@@ -64,26 +64,40 @@ def _marginal_steps_per_sec(exe, program, feed, loss_var, n1=None,
     With repeats > 1, the (n1, n2) pair is measured that many times and
     the MEDIAN estimate is returned along with the relative spread
     (max-min over median) — the repeat-and-report-spread convention
-    that makes regressions smaller than tunnel noise visible."""
+    that makes regressions smaller than tunnel noise visible.
+
+    `feed` may be a LIST of feed dicts, cycled one per step: a
+    STATELESS program rerun on one identical batch repeats the exact
+    same computation, which the tunnel serves from cache (the round-3
+    inference-accounting bug); cycling distinct resident batches keeps
+    every step real compute. Stateful programs chain donated state, so
+    a single feed is fine there."""
     n1 = n1 or N1
     n2 = n2 or N2
     repeats = repeats if repeats is not None else REPEATS
+    feeds = feed if isinstance(feed, (list, tuple)) else [feed]
+
+    step_i = [0]
+
+    def one_step():
+        (out,) = exe.run(program, feed=feeds[step_i[0] % len(feeds)],
+                         fetch_list=[loss_var], return_numpy=False)
+        step_i[0] += 1
+        return out
 
     def timed(n):
         t0 = time.perf_counter()
-        loss = None
+        out = None
         for _ in range(n):
-            (loss,) = exe.run(program, feed=feed, fetch_list=[loss_var],
-                              return_numpy=False)
-        val = np.asarray(loss)  # host readback drains the step chain
+            out = one_step()
+        val = np.asarray(out)  # host readback drains the step chain
         if not np.isfinite(np.ravel(val)[0]):
             raise RuntimeError("non-finite loss in bench — result invalid")
         return time.perf_counter() - t0
 
-    for _ in range(WARMUP):
-        exe.run(program, feed=feed, fetch_list=[loss_var],
-                return_numpy=False)
-    timed(1)     # synced throwaway: drains warmups + any lazy compiles
+    for _ in range(max(WARMUP, 2 * len(feeds))):
+        one_step()   # each distinct feed pays its novel-arg cost here
+    timed(max(1, len(feeds)))  # synced throwaway: drains lazy compiles
     ests = []
     for _ in range(max(1, repeats)):
         t1 = timed(n1)
@@ -268,29 +282,28 @@ def bench_transformer(pt):
     }
     for v in feed.values():
         v.flags.writeable = False
-    sps, _ = _marginal_steps_per_sec(exe, main_p, feed, f["loss"])
-    return b * ln * sps
+    sps, spread = _marginal_steps_per_sec(exe, main_p, feed, f["loss"],
+                                          repeats=3)
+    return b * ln * sps, spread
 
 
 def bench_vgg(pt):
     """VGG-16 ImageNet-shape training (BASELINE config 2's second
     model; benchmark/fluid vgg.py)."""
     from paddle_tpu.models import vgg
-    ips, _ = _bench_image_model(
+    return _bench_image_model(
         pt, lambda: vgg.build_train(class_dim=1000,
                                     image_shape=(3, 224, 224), lr=0.01),
-        64, (3, 224, 224), 1000, repeats=1)
-    return ips
+        64, (3, 224, 224), 1000, repeats=3)
 
 
 def bench_mnist(pt):
     """MNIST conv training (BASELINE config 1; tests/book
     recognize_digits)."""
     from paddle_tpu.models import mnist
-    ips, _ = _bench_image_model(
+    return _bench_image_model(
         pt, mnist.build_train, 512, (1, 28, 28), 10,
-        n1=20, n2=120, repeats=1)
-    return ips
+        n1=20, n2=120, repeats=3)
 
 
 def bench_deepfm(pt):
@@ -311,20 +324,29 @@ def bench_deepfm(pt):
     }
     for v in feed.values():
         v.flags.writeable = False
-    sps, _ = _marginal_steps_per_sec(exe, main_p, feed, f["loss"],
-                                     n1=20, n2=120, repeats=1)
-    return b * sps
+    sps, spread = _marginal_steps_per_sec(exe, main_p, feed, f["loss"],
+                                          n1=20, n2=120, repeats=3)
+    return b * sps, spread
 
 
 def bench_resnet_infer(pt):
     """Saved-model inference throughput: the save_inference_model ->
     load_inference_model product (pruned, test-mode BN) serving a
-    batch — the N19 inference-lib capability measured end to end."""
+    batch — the N19 inference-lib capability measured end to end.
+
+    Round-3 accounting fix (VERDICT r2 item 2): a STATELESS program
+    rerun on one identical cached batch repeats the exact same
+    computation, which the tunnel appears to serve from cache — the
+    old protocol reported 17.4k img/s (~72% of chip peak, physically
+    implausible) vs ~12k measured with varying inputs. The timed loop
+    now cycles K distinct frozen batches (all tunnel-resident after
+    warmup, so the flat novel-argument penalty is paid outside the
+    window) so every step is real compute."""
     import tempfile
 
     from paddle_tpu.models import resnet
 
-    b = 256
+    b, k_batches = 256, 4
     main_p, startup, f = resnet.build_train(class_dim=1000, depth=50)
     exe = pt.Executor()
     exe.run(startup)
@@ -332,12 +354,18 @@ def bench_resnet_infer(pt):
         pt.io.save_inference_model(d, ["img"], [f["pred"]], exe, main_p)
         prog, feeds, fetches = pt.io.load_inference_model(d, exe)
     rng = np.random.RandomState(0)
-    img = rng.rand(b, 3, 224, 224).astype(np.float32)
-    img.flags.writeable = False
-    feed = {feeds[0]: img}
-    sps, _ = _marginal_steps_per_sec(exe, prog, feed, fetches[0],
-                                     repeats=1)
-    return b * sps
+    batches = []
+    for _ in range(k_batches):
+        img = rng.rand(b, 3, 224, 224).astype(np.float32)
+        img.flags.writeable = False
+        batches.append({feeds[0]: img})
+    # stateless ~20ms executes need LONG windows: per-dispatch tunnel
+    # jitter dominates short ones (measured 58% spread at n2=40 vs
+    # ~20% at n2=96)
+    sps, spread = _marginal_steps_per_sec(
+        exe, prog, batches, fetches[0],
+        n1=4 * k_batches, n2=24 * k_batches, repeats=3)
+    return b * sps, spread
 
 
 def bench_lstm_lm(pt):
@@ -357,9 +385,9 @@ def bench_lstm_lm(pt):
             "targets": RaggedPair(ids, lens)}
     # LSTM steps are ~ms-scale: use longer runs so the marginal delta
     # dwarfs tunnel jitter
-    sps, _ = _marginal_steps_per_sec(exe, main_p, feed, f["loss"],
-                                     n1=20, n2=120, repeats=1)
-    return b * t * sps
+    sps, spread = _marginal_steps_per_sec(exe, main_p, feed, f["loss"],
+                                          n1=20, n2=120, repeats=3)
+    return b * t * sps, spread
 
 
 def _run_extra(pt, extras, amp_flag, fn):
@@ -402,38 +430,50 @@ def main():
     extras = {}
 
     def x_transformer():
-        t = bench_transformer(pt)
+        t, sp = bench_transformer(pt)
         return {"transformer_tokens_per_sec": round(t, 0),
                 "transformer_mfu_est": round(
-                    t * TRANSFORMER_FLOPS_PER_TOKEN / V5E_PEAK_FLOPS, 3)}
+                    t * TRANSFORMER_FLOPS_PER_TOKEN / V5E_PEAK_FLOPS, 3),
+                "transformer_spread_pct": round(100 * sp, 1)}
 
     def x_lstm():
         # scan LSTM is latency-bound, not MXU-bound: bf16 casts around
         # the small recurrent matmuls only add overhead
-        t = bench_lstm_lm(pt)
+        t, sp = bench_lstm_lm(pt)
         return {"lstm_lm_tokens_per_sec": round(t, 0),
                 "lstm_lm_vs_baseline": round(
-                    t / BASELINE_LSTM_TOKENS_PER_SEC, 2)}
+                    t / BASELINE_LSTM_TOKENS_PER_SEC, 2),
+                "lstm_lm_spread_pct": round(100 * sp, 1)}
 
     def x_vgg():
-        return {"vgg16_images_per_sec": round(bench_vgg(pt), 0)}
+        ips, sp = bench_vgg(pt)
+        return {"vgg16_images_per_sec": round(ips, 0),
+                "vgg16_spread_pct": round(100 * sp, 1)}
 
     def x_mnist():
-        return {"mnist_images_per_sec": round(bench_mnist(pt), 0)}
+        ips, sp = bench_mnist(pt)
+        return {"mnist_images_per_sec": round(ips, 0),
+                "mnist_spread_pct": round(100 * sp, 1)}
 
     def x_deepfm():
-        return {"deepfm_examples_per_sec": round(bench_deepfm(pt), 0)}
+        eps, sp = bench_deepfm(pt)
+        return {"deepfm_examples_per_sec": round(eps, 0),
+                "deepfm_spread_pct": round(100 * sp, 1)}
 
     def x_infer():
-        return {"resnet50_infer_images_per_sec": round(
-            bench_resnet_infer(pt), 0)}
+        ips, sp = bench_resnet_infer(pt)
+        return {"resnet50_infer_images_per_sec": round(ips, 0),
+                "resnet50_infer_spread_pct": round(100 * sp, 1)}
 
     def x_real_input():
         real_ips, pipeline_ips = bench_resnet_real_input(pt)
         # host_pipeline_vs_compute > 1 means the pipeline keeps the chip
-        # fed; the tunnel's flat per-novel-arg execute penalty caps the
-        # end-to-end number on this link — see MFU_BREAKDOWN.md
+        # fed; the end-to-end number is TUNNEL-BOUND on this link (a
+        # flat ~1-2.4s penalty per novel-argument execute that no input
+        # design can avoid — MFU_BREAKDOWN.md); labeled so the artifact
+        # is self-describing
         return {"resnet50_real_input_images_per_sec": round(real_ips, 2),
+                "resnet50_real_input_tunnel_bound": True,
                 "host_input_pipeline_images_per_sec": round(
                     pipeline_ips, 2),
                 "host_pipeline_vs_compute": round(

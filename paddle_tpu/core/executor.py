@@ -510,6 +510,9 @@ class Executor:
             self._cache[key] = compiled
 
         state_vals = {n: scope.get(n) for n in compiled.read_names}
+        # kept for AOT introspection (profiler cost analysis, the
+        # collective audit's HLO re-lowering)
+        self._last_feed_vals = feed_vals
         fetches, new_state = compiled.fn(feed_vals, state_vals, step)
         scope.set(STEP_VAR, step + 1)
         for n, v in new_state.items():

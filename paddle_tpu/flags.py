@@ -76,6 +76,12 @@ FLAGS: Dict[str, tuple] = {
     "BENCH_REPEATS": ("2", "bench.py",
                       "repeat the headline marginal measurement and "
                       "report median + spread"),
+    "PADDLE_TPU_FLASH_MIN_SEQ": (
+        "512", "ops/nn_ops.py",
+        "minimum sequence length at which fused attention auto-routes "
+        "to the Pallas flash kernel; below it the naive composition "
+        "wins on v5e (measured crossover ~512 — MFU_BREAKDOWN.md "
+        "round 3)"),
     "PADDLE_TPU_BN_CUSTOM_VJP": (
         "0", "ops/nn_ops.py",
         "use the round-2 hand-written BatchNorm backward (custom_vjp) "

@@ -665,8 +665,16 @@ def _sdpa(ctx):
 
     use_flash = ctx.attr("use_flash", None)
     if use_flash is None:
+        # measured crossover on v5e (bf16, h8 d64, fwd+bwd, marginal
+        # protocol): naive/XLA wins 1.56x at S=256, parity at S=512,
+        # flash wins 2.5x at S=1024 and 5.6x at S=4096 — the S^2 score
+        # materialization only starts to bind around 512. Round 2's
+        # threshold of 128 routed the transformer bench's S=256 through
+        # flash and cost it ~35% end-to-end (MFU_BREAKDOWN.md round 3).
+        min_seq = int(os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", "512"))
         use_flash = (jax.default_backend() == "tpu" and q.ndim == 4
-                     and q.shape[2] >= 128 and k.shape[2] >= 128)
+                     and q.shape[2] >= min_seq
+                     and k.shape[2] >= min_seq)
     if use_flash:
         from .pallas import flash_attention
         ctx.set_output("Out", flash_attention(q, k, v, mask, causal=causal))

@@ -1,0 +1,220 @@
+"""Compiled-HLO collective inventory for SPMD layouts.
+
+The reference makes its collectives explicit, auditable graph nodes
+(reference: paddle/fluid/framework/details/nccl_all_reduce_op_handle.cc:30
+— you can SEE the all-reduce in the SSA graph). Under GSPMD the
+collectives are implicit — XLA inserts them from shardings — so this
+module recovers them from the compiled HLO: which collective kinds run,
+over which MESH AXES (classified from replica groups / permute pairs),
+moving how many bytes. The multi-chip dry run prints this inventory and
+asserts the expected collectives per axis, which is the scaling
+evidence a single-chip environment permits: a layout that silently
+loses its gradient all-reduce or its ring permute fails loudly.
+"""
+from __future__ import annotations
+
+import itertools
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8,
+                "s32": 4, "u64": 8, "u32": 4, "s16": 2, "u16": 2,
+                "s8": 1, "u8": 1, "pred": 1, "c64": 8, "c128": 16,
+                "f8e4m3fn": 1, "f8e5m2": 1}
+
+_KINDS = ("all-reduce", "reduce-scatter", "all-gather", "all-to-all",
+          "collective-permute")
+
+
+def _shape_bytes(shape_str: str) -> int:
+    total = 0
+    for m in re.finditer(r"(\w+)\[([\d,]*)\]", shape_str):
+        dt, dims = m.groups()
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dt, 4)
+    return total
+
+
+class Collective:
+    __slots__ = ("kind", "bytes", "groups", "pairs", "axes")
+
+    def __init__(self, kind, nbytes, groups=None, pairs=None):
+        self.kind = kind
+        self.bytes = nbytes
+        self.groups = groups    # list[list[int]] or None
+        self.pairs = pairs      # list[(src, dst)] or None
+        self.axes: Optional[Tuple[str, ...]] = None
+
+    def __repr__(self):
+        ax = "+".join(self.axes) if self.axes else "?"
+        return f"<{self.kind} over {ax}: {self.bytes / 1e6:.2f}MB>"
+
+
+def _decode_iota_groups(g, s, dims, perm) -> List[List[int]]:
+    """XLA's iota replica-group v2 form `[G,S]<=[dims]T(perm)`: device
+    ids 0..prod(dims)-1 reshaped to `dims`, transposed by `perm`, then
+    reshaped to G groups of S."""
+    ids = np.arange(int(np.prod(dims))).reshape(dims)
+    if perm is not None:
+        ids = ids.transpose(perm)
+    return [[int(v) for v in row] for row in ids.reshape(g, s)]
+
+
+def parse_collectives(hlo_text: str) -> List[Collective]:
+    """Collective instructions (incl. -start forms) from HLO text.
+    Handles both literal replica_groups={{0,1},{2,3}} and the iota
+    form replica_groups=[G,S]<=[dims]T(perm)."""
+    out = []
+    for ln in hlo_text.splitlines():
+        m = re.search(
+            r"= ((?:\([^)]*\)|\S+)) (all-reduce|reduce-scatter|all-gather"
+            r"|all-to-all|collective-permute)(?:-start)?\(", ln)
+        if not m:
+            continue
+        shape, kind = m.groups()
+        groups = pairs = None
+        if kind == "collective-permute":
+            pm = re.search(
+                r"source_target_pairs=\{((?:\{\d+,\s*\d+\},?)+)\}", ln)
+            if pm:
+                pairs = [tuple(int(x) for x in p.split(","))
+                         for p in re.findall(r"\{(\d+,\s*\d+)\}",
+                                             pm.group(1))]
+        else:
+            gm = re.search(
+                r"replica_groups=\{((?:\{[\d,\s]*\},?)+)\}", ln)
+            im = re.search(
+                r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\]"
+                r"(?:T\(([\d,]+)\))?", ln)
+            if gm:
+                groups = [[int(x) for x in g.split(",") if x.strip()]
+                          for g in re.findall(r"\{([\d,\s]*)\}",
+                                              gm.group(1))]
+                groups = [g for g in groups if g]
+            elif im:
+                g, s, dims, perm = im.groups()
+                groups = _decode_iota_groups(
+                    int(g), int(s),
+                    [int(d) for d in dims.split(",")],
+                    [int(p) for p in perm.split(",")] if perm else None)
+        out.append(Collective(kind, _shape_bytes(shape), groups, pairs))
+    return out
+
+
+def _axis_partitions(mesh) -> Dict[Tuple[str, ...], set]:
+    """For every non-empty subset of mesh axes: the partition of linear
+    device indices obtained by varying exactly those axes (as a set of
+    frozensets)."""
+    names = list(mesh.axis_names)
+    shape = [mesh.shape[n] for n in names]
+    idx = np.arange(int(np.prod(shape))).reshape(shape)
+    parts = {}
+    for r in range(1, len(names) + 1):
+        for combo in itertools.combinations(range(len(names)), r):
+            other = [i for i in range(len(names)) if i not in combo]
+            moved = np.moveaxis(idx, combo, range(len(combo)))
+            flat = moved.reshape(
+                int(np.prod([shape[i] for i in combo])), -1)
+            groups = {frozenset(int(v) for v in flat[:, j])
+                      for j in range(flat.shape[1])}
+            parts[tuple(names[i] for i in combo)] = groups
+    return parts
+
+
+def classify(collectives: List[Collective], mesh) -> List[Collective]:
+    """Tag each collective with the mesh-axis subset its groups span."""
+    parts = _axis_partitions(mesh)
+    n_dev = int(np.prod([mesh.shape[n] for n in mesh.axis_names]))
+    for c in collectives:
+        if c.groups:
+            got = {frozenset(g) for g in c.groups}
+            if got == {frozenset(range(n_dev))} and \
+                    len(mesh.axis_names) > 1:
+                c.axes = tuple(mesh.axis_names)
+                continue
+            for axes, groups in parts.items():
+                if got == groups:
+                    c.axes = axes
+                    break
+        elif c.pairs:
+            # a permute belongs to axis a if every (src, dst) differs
+            # in exactly the a-coordinate (ring/neighbor exchange)
+            names = list(mesh.axis_names)
+            shape = [mesh.shape[n] for n in names]
+            coords = {i: np.unravel_index(i, shape)
+                      for i in range(n_dev)}
+            for ai, name in enumerate(names):
+                ok = all(
+                    all(coords[s][j] == coords[d][j]
+                        for j in range(len(names)) if j != ai)
+                    and coords[s][ai] != coords[d][ai]
+                    for s, d in c.pairs)
+                if ok and c.pairs:
+                    c.axes = (name,)
+                    break
+    return collectives
+
+
+def inventory(hlo_text: str, mesh) -> Dict[Tuple[str, Tuple[str, ...]],
+                                           Tuple[int, int]]:
+    """{(kind, axes): (count, total_bytes)} for one compiled program."""
+    inv: Dict = {}
+    for c in classify(parse_collectives(hlo_text), mesh):
+        key = (c.kind, c.axes or ("?",))
+        cnt, b = inv.get(key, (0, 0))
+        inv[key] = (cnt + 1, b + c.bytes)
+    return inv
+
+
+def format_inventory(inv) -> str:
+    lines = []
+    for (kind, axes), (cnt, b) in sorted(inv.items(),
+                                         key=lambda kv: -kv[1][1]):
+        lines.append(f"  {kind:20s} over {'+'.join(axes):18s} "
+                     f"x{cnt:3d}  {b / 1e6:10.2f} MB")
+    return "\n".join(lines) if lines else "  (no collectives)"
+
+
+def assert_collectives(inv, expectations) -> None:
+    """expectations: list of (kinds, axis) — at least one collective
+    whose kind is in `kinds` and whose axis set CONTAINS `axis` must
+    exist (GSPMD may legally merge axes, e.g. one all-reduce over
+    data+seq for gradients replicated across both)."""
+    for kinds, axis in expectations:
+        hit = any(kind in kinds and axis in axes
+                  for (kind, axes), _ in inv.items())
+        if not hit:
+            raise AssertionError(
+                f"expected a {'/'.join(kinds)} collective over axis "
+                f"{axis!r}; inventory:\n" + format_inventory(inv))
+
+
+def compiled_hlo_for(exe, program, scope=None) -> str:
+    """Compiled HLO text of the (single) cached executable for
+    `program` in executor `exe` — AOT re-lowering with the same
+    abstract state the last run used."""
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    scope = scope or pt.global_scope()
+    uid = program.desc.uid if hasattr(program, "desc") else program.uid
+    entry = next(v for k, v in exe._cache.items() if k[0] == uid)
+    raise_if = [n for n in entry.ro_names + entry.rw_names
+                if scope.find(n) is None]
+    if raise_if:
+        raise RuntimeError(f"state missing from scope: {raise_if[:5]}")
+    ro = {n: scope.get(n) for n in entry.ro_names}
+    rw = {n: scope.get(n) for n in entry.rw_names}
+    feed_vals = getattr(exe, "_last_feed_vals", None)
+    if feed_vals is None:
+        raise RuntimeError(
+            "no recorded feed for AOT lowering — run the program once "
+            "before compiled_hlo_for (the executor records the last "
+            "feed values)")
+    lowered = entry.jitted.lower(feed_vals, ro, rw,
+                                 jnp.zeros((), jnp.int32))
+    return lowered.compile().as_text()
