@@ -35,6 +35,13 @@ BASELINE_IMAGES_PER_SEC = 81.69
 # Reference LSTM anchor: benchmark/README.md:112-119 — 184 ms/batch at
 # batch 64, hidden 512, seq len 100 on 1x K40m => ~34.8k tokens/s.
 BASELINE_LSTM_TOKENS_PER_SEC = 64 * 100 / 0.184
+# AlexNet anchor: benchmark/README.md:31-38 — 334 ms/batch at bs128 on
+# 1x K40m. GoogLeNet: best published bs128 number is the CPU MKL-DNN
+# 264.83 img/s (IntelOptimizedPaddle.md:50-56), measured WITHOUT the
+# aux heads (benchmark/paddle/image/googlenet.py:220) — the bench
+# matches that protocol (with_aux=False, bs128).
+BASELINE_ALEXNET_IPS = 128 / 0.334
+BASELINE_GOOGLENET_IPS = 264.83
 
 # MFU accounting (north star: >=50% MFU ResNet-50): v5e peak bf16
 # throughput per chip. ResNet-50 forward is ~4.1 GMAC/image at 224^2;
@@ -297,6 +304,28 @@ def bench_vgg(pt):
         64, (3, 224, 224), 1000, repeats=3)
 
 
+def bench_alexnet(pt):
+    """AlexNet bs128 (reference anchor: benchmark/README.md:31-38)."""
+    from paddle_tpu.models import alexnet
+    # ~8ms steps: long windows, like mnist (short ones are tunnel noise)
+    return _bench_image_model(
+        pt, lambda: alexnet.build_train(class_dim=1000,
+                                        image_shape=(3, 224, 224),
+                                        lr=0.01),
+        128, (3, 224, 224), 1000, n1=20, n2=120, repeats=3)
+
+
+def bench_googlenet(pt):
+    """GoogLeNet bs128 (reference anchors: benchmark/README.md:45-51,
+    IntelOptimizedPaddle.md:50-56)."""
+    from paddle_tpu.models import googlenet
+    return _bench_image_model(
+        pt, lambda: googlenet.build_train(class_dim=1000,
+                                          image_shape=(3, 224, 224),
+                                          lr=0.01, with_aux=False),
+        128, (3, 224, 224), 1000, n1=10, n2=60, repeats=3)
+
+
 def bench_mnist(pt):
     """MNIST conv training (BASELINE config 1; tests/book
     recognize_digits)."""
@@ -450,6 +479,20 @@ def main():
         return {"vgg16_images_per_sec": round(ips, 0),
                 "vgg16_spread_pct": round(100 * sp, 1)}
 
+    def x_alexnet():
+        ips, sp = bench_alexnet(pt)
+        return {"alexnet_images_per_sec": round(ips, 0),
+                "alexnet_vs_baseline": round(ips / BASELINE_ALEXNET_IPS,
+                                             2),
+                "alexnet_spread_pct": round(100 * sp, 1)}
+
+    def x_googlenet():
+        ips, sp = bench_googlenet(pt)
+        return {"googlenet_images_per_sec": round(ips, 0),
+                "googlenet_vs_baseline": round(
+                    ips / BASELINE_GOOGLENET_IPS, 2),
+                "googlenet_spread_pct": round(100 * sp, 1)}
+
     def x_mnist():
         ips, sp = bench_mnist(pt)
         return {"mnist_images_per_sec": round(ips, 0),
@@ -484,6 +527,8 @@ def main():
     if RUN_EXTRAS:
         _run_extra(pt, extras, False, x_lstm)
         _run_extra(pt, extras, amp_on, x_vgg)
+        _run_extra(pt, extras, amp_on, x_alexnet)
+        _run_extra(pt, extras, amp_on, x_googlenet)
         _run_extra(pt, extras, amp_on, x_mnist)
         _run_extra(pt, extras, False, x_deepfm)
         _run_extra(pt, extras, amp_on, x_infer)

@@ -260,8 +260,8 @@ def _dynamic_while_targets(block: BlockDesc):
             return ("while", attrs.get("while_id"))
         if t in ("dynamic_rnn", "static_rnn"):
             return (t, attrs.get("sub_block_idx"))
-        if t == "cond":
-            return ("cond", attrs.get("true_block_idx"),
+        if t in ("cond", "if_else"):
+            return (t, attrs.get("true_block_idx"),
                     attrs.get("false_block_idx"))
         return None
 

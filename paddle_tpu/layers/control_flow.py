@@ -118,8 +118,8 @@ class StaticRNN:
                  "mem_pre_names": [v.name for v in self._mem_pre],
                  "mem_new_names": [v.name for v in self._mem_new],
                  "out_names": [o.name for o in self._outputs]}
-        _wire_nested_steps(helper, self._parent_prog, self._block,
-                           outputs, attrs)
+        _wire_nested_steps(helper, self._parent_prog,
+                           [self._block.desc.idx], outputs, attrs)
         helper.append_op(
             type="static_rnn",
             inputs={"X": [x for x, _ in self._inputs],
@@ -250,8 +250,8 @@ class DynamicRNN:
                  "mem_pre_names": [v.name for v in self._mem_pre],
                  "mem_new_names": [v.name for v in self._mem_new],
                  "out_names": [o.name for o in self._outputs]}
-        _wire_nested_steps(helper, self._parent_prog, self._block,
-                           outputs, attrs)
+        _wire_nested_steps(helper, self._parent_prog,
+                           [self._block.desc.idx], outputs, attrs)
         helper.append_op(
             type="dynamic_rnn",
             inputs={"X": [x for x, _ in self._inputs],
@@ -332,33 +332,38 @@ class IfElse:
         helper = self.helper
         self._result_vars = [helper.create_tmp_variable(o.dtype)
                              for o in t_outs]
-        helper.append_op(
-            type="if_else",
-            inputs={"Cond": self.cond},
-            outputs={"Out": self._result_vars},
-            attrs={"true_block_idx": self._blocks["true"].idx,
-                   "false_block_idx": self._blocks["false"].idx,
-                   "true_out_names": [o.name for o in t_outs],
-                   "false_out_names": [o.name for o in f_outs]})
+        outputs = {"Out": self._result_vars}
+        attrs = {"true_block_idx": self._blocks["true"].idx,
+                 "false_block_idx": self._blocks["false"].idx,
+                 "true_out_names": [o.name for o in t_outs],
+                 "false_out_names": [o.name for o in f_outs]}
+        # dynamic Whiles in either branch surface their trip counts
+        # (both branches EXECUTE in the dense lowering, so the op
+        # reports the max over branches)
+        _wire_nested_steps(helper, default_main_program(),
+                           [self._blocks["true"].idx,
+                            self._blocks["false"].idx],
+                           outputs, attrs)
+        helper.append_op(type="if_else", inputs={"Cond": self.cond},
+                         outputs=outputs, attrs=attrs)
 
     def __call__(self):
         res = self._result_vars
         return res[0] if len(res) == 1 else res
 
 
-def _wire_nested_steps(helper, prog, blk, outputs, attrs):
-    """Dynamic (unbounded) Whiles nested anywhere under `blk` get one
-    parent-block int32 var each, wired as the enclosing op's
-    NestedSteps outputs: the op max-accumulates every nested loop's
-    per-iteration trip count into them, and the executor's
-    probe-and-replay WhileGrad reads them to bake one static bound per
-    nesting level (reference: while_op.cc:96 step scopes, which nest
-    freely). The wid order comes from the SAME traversal the op-side
-    lowering uses (ops/control_flow_ops.nested_dynamic_wids) — the
-    executor zips these vars with that list, so a single source of
-    truth keeps them aligned."""
-    from ..ops.control_flow_ops import nested_dynamic_wids
-    wids = nested_dynamic_wids(prog.desc, blk.desc.idx)
+def _wire_nested_steps(helper, prog, blk_idxs, outputs, attrs):
+    """Dynamic (unbounded) Whiles nested anywhere under the blocks in
+    `blk_idxs` get one parent-block int32 var each, wired as the
+    enclosing op's NestedSteps outputs: the op max-accumulates every
+    nested loop's per-iteration trip count into them, and the
+    executor's probe-and-replay WhileGrad reads them to bake one static
+    bound per nesting level (reference: while_op.cc:96 step scopes,
+    which nest freely). Ordering is owned by ONE function
+    (ops/control_flow_ops.union_nested_wids) shared by the layers, the
+    op lowerings, and the executor's zip."""
+    from ..ops.control_flow_ops import union_nested_wids
+    wids = union_nested_wids(prog.desc, blk_idxs)
     if wids:
         step_vars = [
             helper.create_variable(
@@ -439,7 +444,8 @@ class While:
                  "max_steps": int(self.max_steps or 0),
                  "while_id": self.helper.name,
                  "dynamic_bound": self.max_steps is None}
-        _wire_nested_steps(self.helper, self._prog, blk, outputs, attrs)
+        _wire_nested_steps(self.helper, self._prog,
+                           [blk.desc.idx], outputs, attrs)
         self.helper.append_op(
             type="while", inputs={"Cond": self.cond_var},
             outputs=outputs, attrs=attrs)
@@ -537,22 +543,8 @@ def cond_op(pred, true_fn, false_fn):
              "false_block_idx": fb.idx,
              "true_out": true_out.name,
              "false_out": false_out.name}
-    # dynamic Whiles in either branch surface their trip counts, in the
-    # same union order the op-side lowering computes
-    from ..ops.control_flow_ops import nested_dynamic_wids
-    wids = []
-    for b in (tb.idx, fb.idx):
-        for w in nested_dynamic_wids(prog.desc, b):
-            if w not in wids:
-                wids.append(w)
-    if wids:
-        step_vars = [
-            helper.create_variable(
-                name=f"{helper.name}.nested_steps.{i}", dtype="int32",
-                shape=[], stop_gradient=True)
-            for i in range(len(wids))]
-        outputs["NestedSteps"] = [v.name for v in step_vars]
-        attrs["nested_while_ids"] = wids
+    # dynamic Whiles in either branch surface their trip counts
+    _wire_nested_steps(helper, prog, [tb.idx, fb.idx], outputs, attrs)
     helper.append_op(type="cond", inputs={"Pred": pred},
                      outputs=outputs, attrs=attrs)
     return out

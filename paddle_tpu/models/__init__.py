@@ -1,11 +1,14 @@
 """Model zoo matching the reference's benchmark/book models
-(BASELINE.json configs): MNIST conv, ResNet-50, VGG-16, stacked-LSTM
-language model, Transformer NMT, DeepFM CTR.
+(BASELINE.json configs + the benchmark/README anchors): MNIST conv,
+ResNet-50 (+SE-ResNeXt), VGG-16, AlexNet, GoogLeNet, stacked-LSTM
+language model, Transformer NMT, DeepFM CTR, SSD detector.
 """
+from . import alexnet  # noqa: F401
+from . import deepfm  # noqa: F401
+from . import googlenet  # noqa: F401
+from . import lstm_lm  # noqa: F401
 from . import mnist  # noqa: F401
 from . import resnet  # noqa: F401
-from . import vgg  # noqa: F401
-from . import lstm_lm  # noqa: F401
-from . import transformer  # noqa: F401
-from . import deepfm  # noqa: F401
 from . import ssd  # noqa: F401
+from . import transformer  # noqa: F401
+from . import vgg  # noqa: F401

@@ -47,6 +47,20 @@ def nested_dynamic_wids(program, blk_idx):
     return out
 
 
+def union_nested_wids(program, blk_idxs):
+    """Deduped union of nested_dynamic_wids over several blocks, in
+    block order — THE ordering contract between an op's declared
+    nested_while_ids attr, its NestedSteps outputs, and the executor's
+    zip of the two. Every layer/op that wires nested trip counts goes
+    through this one function."""
+    wids = []
+    for b in blk_idxs:
+        for w in nested_dynamic_wids(program, b):
+            if w not in wids:
+                wids.append(w)
+    return wids
+
+
 def _collect_reports(ctx, trace_fn):
     """Run `trace_fn()` with a fresh nested-steps report dict in
     ctx.extra; returns (trace result, {wid: steps tracer}) reported by
@@ -224,11 +238,9 @@ def _cond(ctx):
     # trace into an enclosing collector directly (the untaken branch
     # contributes zeros, which can only under-report; the probe only
     # needs counts for what actually EXECUTED)
-    wids = []
-    for b in (tb, fb):
-        for w in nested_dynamic_wids(prog, b):
-            if w not in wids:
-                wids.append(w)
+    wids = ctx.attr("nested_while_ids", None)
+    if wids is None:   # op built without the layer: same union, same order
+        wids = union_nested_wids(prog, (tb, fb))
 
     def make_branch(blk_idx, out_name):
         def branch(_):
@@ -361,9 +373,17 @@ def _if_else(ctx):
     outer = dict(ctx.env)
     true_outs = ctx.attr("true_out_names")
     false_outs = ctx.attr("false_out_names")
+    prog = ctx.extra["program"]
+    tb = ctx.attr("true_block_idx")
+    fb = ctx.attr("false_block_idx")
+    wids = ctx.attr("nested_while_ids", None)
+    if wids is None:
+        wids = union_nested_wids(prog, (tb, fb))
 
-    env_t = _trace_sub(ctx, ctx.attr("true_block_idx"), dict(outer))
-    env_f = _trace_sub(ctx, ctx.attr("false_block_idx"), dict(outer))
+    env_t, rep_t = _collect_reports(
+        ctx, lambda: _trace_sub(ctx, tb, dict(outer)))
+    env_f, rep_f = _collect_reports(
+        ctx, lambda: _trace_sub(ctx, fb, dict(outer)))
     c = cond.reshape(-1).astype(jnp.bool_)
     merged = []
     for tn, fn in zip(true_outs, false_outs):
@@ -371,6 +391,12 @@ def _if_else(ctx):
         m = c.reshape((-1,) + (1,) * (tv.ndim - 1))
         merged.append(jnp.where(m, tv, fv))
     ctx.set_outputs("Out", merged)
+    # both branches execute in the dense lowering: report the max
+    maxes = tuple(jnp.maximum(rep_t.get(w, _zero_steps()),
+                              rep_f.get(w, _zero_steps()))
+                  for w in wids)
+    ctx.set_outputs("NestedSteps", list(maxes))
+    _publish_report(ctx, dict(zip(wids, maxes)))
 
 
 @register_op_CF("pipeline")

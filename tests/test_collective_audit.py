@@ -107,3 +107,31 @@ def test_dp_tp_training_program_has_expected_collectives():
     data_bytes = sum(b for (k, axes), (_c, b) in inv.items()
                      if "data" in axes and k == "all-reduce")
     assert data_bytes > 0
+
+
+@pytest.mark.parametrize("impl,expect_kind", [
+    ("ring", "collective-permute"),
+    ("ulysses", "all-to-all"),
+])
+def test_sequence_parallel_attention_collectives(impl, expect_kind):
+    """The two context-parallel schemes compile to their signature
+    collectives over the 'seq' axis: ring -> neighbor
+    collective-permute, Ulysses -> head/seq all-to-all."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.context_parallel import (
+        sequence_parallel_attention)
+
+    mesh = make_mesh((4,), ("seq",), devices=jax.devices()[:4])
+    B, H, S, D = 2, 4, 64, 16
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+
+    def fn(q, k, v):
+        return sequence_parallel_attention(q, k, v, mesh, axis="seq",
+                                           impl=impl, causal=True)
+
+    hlo = jax.jit(fn).lower(q, q, q).compile().as_text()
+    inv = ca.inventory(hlo, mesh)
+    ca.assert_collectives(inv, [((expect_kind,), "seq")])

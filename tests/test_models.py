@@ -134,3 +134,40 @@ def test_se_resnext_trains():
         losses.append(float(np.asarray(lv).reshape(-1)[0]))
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_alexnet_builds_and_steps():
+    """Reference anchor: benchmark/README.md:31-38 AlexNet."""
+    from paddle_tpu.models import alexnet
+    main, startup, f = alexnet.build_train(class_dim=10,
+                                           image_shape=(3, 224, 224),
+                                           lr=0.01)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(3):
+        feed = {"img": rng.rand(4, 3, 224, 224).astype(np.float32),
+                "label": rng.randint(0, 10, (4, 1)).astype(np.int64)}
+        (lv,) = exe.run(main, feed=feed, fetch_list=[f["loss"]])
+        losses.append(float(np.asarray(lv)))
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_googlenet_builds_and_steps():
+    """Reference anchor: benchmark/README.md:45-51 GoogLeNet; the two
+    auxiliary heads contribute 0.3-weighted losses at train time."""
+    from paddle_tpu.models import googlenet
+    main, startup, f = googlenet.build_train(class_dim=10,
+                                             image_shape=(3, 224, 224),
+                                             lr=0.01)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(3):
+        feed = {"img": rng.rand(2, 3, 224, 224).astype(np.float32),
+                "label": rng.randint(0, 10, (2, 1)).astype(np.int64)}
+        (lv,) = exe.run(main, feed=feed, fetch_list=[f["loss"]])
+        losses.append(float(np.asarray(lv)))
+    assert all(np.isfinite(l) for l in losses)

@@ -388,3 +388,61 @@ def test_dynamic_while_inside_cond_branch():
     eps = 1e-3
     g_fd = (host(x0 + eps, to, ti) - host(x0 - eps, to, ti)) / (2 * eps)
     np.testing.assert_allclose((x0 - x1) / lr, g_fd, rtol=1e-3)
+
+
+def test_dynamic_while_inside_if_else_trains():
+    """A dynamic While inside an IfElse branch (dense both-branch
+    lowering): both branches execute, so the op reports the max of the
+    branch trip counts and the probe bakes the bound."""
+    lr, x0 = 0.001, 0.3
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.create_parameter(
+            shape=[1], dtype="float32", name="xp_ifelse",
+            default_initializer=pt.initializer.ConstantInitializer(x0))
+        thr = layers.data("thr", [1], dtype="float32")
+        sel = layers.data("sel", [1], dtype="float32")
+        cond = cf.less_than_v(sel, layers.fill_constant(
+            [1], "float32", 0.5))
+        ie = cf.IfElse(cond)
+        with ie.true_block():
+            t = layers.fill_constant([1], "float32", 0.0)
+            t.stop_gradient = False
+            cond_i = cf.less_than_v(t, thr)
+            w_i = cf.While(cond_i)          # NO max_steps
+            with w_i.block():
+                layers.assign(layers.elementwise_add(t, x), output=t)
+                cf.less_than_v(t, thr, cond=cond_i)
+            ie.output(t)
+        with ie.false_block():
+            ie.output(layers.scale(x, scale=3.0))
+        out = ie()
+        loss = layers.reduce_sum(layers.square(out))
+        pt.optimizer.SGDOptimizer(learning_rate=lr).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+
+    def host(xv, sel_v):
+        if sel_v < 0.5:
+            t = 0.0
+            while t < 1.0:
+                t += xv
+            return t * t
+        return (3 * xv) ** 2
+
+    for sel_v in (0.0, 1.0):    # true branch taken, then false branch
+        x_before = float(np.asarray(
+            pt.global_scope().get("xp_ifelse")).reshape(()))
+        (lv,) = exe.run(main,
+                        feed={"thr": np.asarray([1.0], np.float32),
+                              "sel": np.asarray([sel_v], np.float32)},
+                        fetch_list=[loss])
+        np.testing.assert_allclose(float(np.asarray(lv)),
+                                   host(x_before, sel_v), rtol=1e-4)
+        x_after = float(np.asarray(
+            pt.global_scope().get("xp_ifelse")).reshape(()))
+        eps = 1e-3
+        g_fd = (host(x_before + eps, sel_v)
+                - host(x_before - eps, sel_v)) / (2 * eps)
+        np.testing.assert_allclose((x_before - x_after) / lr, g_fd,
+                                   rtol=1e-3)
