@@ -10,10 +10,12 @@ by process 0. Loading reassembles global arrays for a caller-supplied
 target sharding via jax.make_array_from_callback.
 
 Requirements: a filesystem all processes can reach (the standard
-checkpoint contract), and load-time shardings whose per-process pieces
-match the saved pieces exactly (same mesh topology — resharding on
-restore is out of scope; save/restore with the same parallel layout,
-as the reference's pserver shards do).
+checkpoint contract). Load-time shardings that MATCH the saved pieces
+restore piece-by-piece (zero reassembly); a DIFFERENT topology (round
+3: elastic resharding, like the reference pserver checkpoints'
+add/remove-trainer elasticity, go/pserver service.go) falls back to
+assembling the var from all saved pieces and slicing the requested
+index out.
 """
 from __future__ import annotations
 
@@ -152,18 +154,44 @@ def load_sharded(dirname: str,
         shape = tuple(entry.get("shape") or ())
         if name in shardings:
             sh = shardings[name]
+            dtype = np.dtype(entry["dtype"]) if entry.get("dtype") \
+                else None
+            assembled = {}     # lazy full-array cache for resharding
 
-            def cb(index, _name=name, _pieces=pieces, _shape=shape):
+            def cb(index, _name=name, _pieces=pieces, _shape=shape,
+                   _dtype=dtype, _assembled=assembled):
                 key = _index_key(index, _shape)
-                if key in _pieces:
+                if key in _pieces:      # exact layout match: zero copy
                     return shard_file(_pieces[key])[f"{_name}|{key}"]
                 if "" in _pieces:  # replicated save: slice the full copy
                     full = shard_file(_pieces[""])[f"{_name}|"]
                     return full[index]
-                raise KeyError(
-                    f"checkpoint has no piece {key!r} of {_name!r} — "
-                    "restore with the same sharding layout it was "
-                    "saved under")
+                # elastic resharding: the requested index does not match
+                # any saved piece (different mesh topology) — assemble
+                # the full var from its pieces once, then slice
+                if "full" not in _assembled:
+                    if _dtype is None:
+                        raise KeyError(
+                            f"cannot reshard {_name!r}: checkpoint "
+                            "index lacks its dtype (saved by an older "
+                            "version) and no piece matches "
+                            f"{key!r} — restore with the saved layout")
+                    out = np.zeros(_shape, _dtype)
+                    covered = 0
+                    for k, proc in _pieces.items():
+                        piece = shard_file(proc)[f"{_name}|{k}"]
+                        out[_parse_index(k, _shape)] = piece
+                        covered += int(piece.size)
+                    # incomplete coverage must stay LOUD: a zero-filled
+                    # gap would resume training from corrupt weights
+                    if covered != int(np.prod(_shape)):
+                        raise KeyError(
+                            f"checkpoint pieces of {_name!r} cover "
+                            f"{covered} of {int(np.prod(_shape))} "
+                            "elements — incomplete save, refusing to "
+                            "zero-fill the gap")
+                    _assembled["full"] = out
+                return _assembled["full"][index]
 
             arr = jax.make_array_from_callback(shape, sh, cb)
             scope.set(name, arr)
