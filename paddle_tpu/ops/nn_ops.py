@@ -322,6 +322,12 @@ def _batch_norm(ctx):
 
 @register_op("layer_norm")
 def _layer_norm(ctx):
+    """Naive mean -> var -> normalize form ON PURPOSE: the round-3
+    single-pass/coefficient rewrite (the form that paid off for
+    batch_norm) measured 5-12% SLOWER for the transformer in
+    order-controlled same-session A/Bs — LN reduces over the minor
+    (d_model) dim where XLA fuses the row-local chain fine, and the
+    coefficient broadcasts only add traffic (MFU_BREAKDOWN.md r3)."""
     x = ctx.input("X")
     scale = ctx.input("Scale")
     bias = ctx.input("Bias")
