@@ -14,8 +14,10 @@ from .framework import (  # noqa
     default_startup_program, program_guard, unique_name,
     reset_default_programs,
 )
-from .executor import Executor, CPUPlace, TPUPlace  # noqa
-from .layer_helper import LayerHelper, ParamAttr  # noqa
+from .executor import (Executor, CPUPlace, CUDAPlace,  # noqa
+                       TPUPlace, scope_guard)
+from .layer_helper import (LayerHelper, ParamAttr,  # noqa
+                           WeightNormParamAttr)
 from . import layers  # noqa
 from . import initializer  # noqa
 from . import optimizer  # noqa
@@ -32,5 +34,22 @@ from . import reader  # noqa
 from .reader import batch  # noqa
 from . import concurrency  # noqa
 from . import amp  # noqa
+
+# reference fluid.__all__ surface (module paths a migrating user
+# imports directly; see each shim's docstring)
+from .core import backward  # noqa
+from .core.lod import LoDTensor as Tensor  # noqa
+from . import average  # noqa
+from . import default_scope_funcs  # noqa
+from . import evaluator  # noqa
+from . import learning_rate_decay  # noqa
+from . import param_attr  # noqa
+from . import recordio_writer  # noqa
+from .data_feeder import DataFeeder  # noqa
+from .transpiler.distribute_transpiler import (  # noqa
+    DistributeTranspiler, DistributeTranspiler as
+    SimpleDistributeTranspiler)
+from .transpiler.memory_optimization_transpiler import (  # noqa
+    memory_optimize, release_memory)
 
 __version__ = "0.1.0"

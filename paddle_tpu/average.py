@@ -1,0 +1,44 @@
+"""Streaming weighted average (reference:
+python/paddle/fluid/average.py:35 WeightedAverage — the host-side
+loss/metric accumulator the book chapters print per pass). Distinct
+from optimizer.ModelAverage (parameter averaging)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+class WeightedAverage:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = None
+        self.denominator = None
+
+    def add(self, value, weight):
+        if not isinstance(value, (int, float, np.number, np.ndarray)) \
+                or isinstance(value, bool):
+            raise ValueError(
+                "The 'value' must be a number or a numpy ndarray.")
+        # the reference accepts any single-element number-like weight
+        # (typical migrating code feeds a fetched batch-size ndarray)
+        if isinstance(weight, np.ndarray) and weight.size == 1:
+            weight = float(weight.reshape(()))
+        if isinstance(weight, bool) or \
+                not isinstance(weight, (int, float, np.number)):
+            raise ValueError("The 'weight' must be a number.")
+        weight = float(weight)
+        if self.numerator is None or self.denominator is None:
+            self.numerator = value * weight
+            self.denominator = weight
+        else:
+            self.numerator += value * weight
+            self.denominator += weight
+
+    def eval(self):
+        if self.numerator is None or self.denominator is None:
+            raise ValueError(
+                "There is no data to be averaged in WeightedAverage.")
+        return self.numerator / self.denominator

@@ -1,0 +1,65 @@
+"""Default-scope helpers (reference:
+python/paddle/fluid/default_scope_funcs.py — a thread-local scope
+stack with enter/leave and a scoped_function decorator). Mapped onto
+core.scope's Scope chain: entering pushes a child of the current
+scope, leaving pops and discards it."""
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from .core.scope import Scope, global_scope
+
+__all__ = ["get_cur_scope", "enter_local_scope", "leave_local_scope",
+           "var", "find_var", "scoped_function"]
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = [global_scope()]
+    # a fresh global scope (tests reset it) restarts the chain
+    if _tls.stack[0] is not global_scope():
+        _tls.stack = [global_scope()]
+    return _tls.stack
+
+
+def get_cur_scope() -> Scope:
+    """Innermost scope of the current thread."""
+    return _stack()[-1]
+
+
+def enter_local_scope() -> Scope:
+    child = Scope(parent=get_cur_scope())
+    _stack().append(child)
+    return child
+
+
+def leave_local_scope() -> None:
+    stack = _stack()
+    if len(stack) == 1:
+        raise RuntimeError("cannot leave the global scope")
+    stack.pop()
+
+
+def var(name: str):
+    """Create (or fetch) `name` in the current scope; returns its
+    value slot name — set it with get_cur_scope().set(name, value)."""
+    scope = get_cur_scope()
+    if not scope.has(name):
+        scope.set(name, None)
+    return name
+
+
+def find_var(name: str):
+    return get_cur_scope().find(name)
+
+
+def scoped_function(func: Callable):
+    """Run `func` inside a fresh local scope, always leaving it."""
+    enter_local_scope()
+    try:
+        return func()
+    finally:
+        leave_local_scope()

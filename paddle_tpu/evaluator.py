@@ -1,0 +1,9 @@
+"""Module-path parity shim (reference: python/paddle/fluid/evaluator.py
+— Accuracy/ChunkEvaluator/EditDistance/DetectionMAP). The evaluators
+live in metrics.py (one streaming-metric library instead of the
+reference's evaluator/metrics split)."""
+from .metrics import (Accuracy, ChunkEvaluator,  # noqa: F401
+                      DetectionMAP, EditDistance)
+
+__all__ = ["Accuracy", "ChunkEvaluator", "EditDistance",
+           "DetectionMAP"]

@@ -40,6 +40,24 @@ class ParamAttr:
         raise TypeError(f"bad param_attr {arg!r}")
 
 
+class WeightNormParamAttr(ParamAttr):
+    """Weight-normalization attribute (reference: param_attr.py:90 +
+    layer_helper.py's __weight_normalize): the layer's weight becomes
+    w = g * v / ||v||, with the norm over every dim EXCEPT `dim`
+    (dim=None: one global norm, scalar g). v carries the requested
+    initializer; g starts at ||v_init|| (computed by startup ops), so
+    training begins exactly at the initialized weight."""
+
+    # kept for reference-API compatibility (param_attr.py:100); the
+    # reference used it to discriminate reparameterized params during
+    # serialization — unused here (w is a plain derived var)
+    params_with_weight_norm: list = []
+
+    def __init__(self, dim: Optional[int] = None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+
 # Active parameter-stacking contexts (innermost last). While a
 # PipelinedStack block is being built, every parameter created inside it
 # gets a leading per-stage dim and is recorded — see
@@ -135,7 +153,76 @@ class LayerHelper:
             name=name, shape=shape, dtype=dtype, trainable=attr.trainable,
             regularizer=attr.regularizer)
         p.optimize_attr = {"learning_rate": attr.learning_rate}
+        if isinstance(attr, WeightNormParamAttr):
+            if _PARAM_STACK_CTX:
+                raise NotImplementedError(
+                    "WeightNormParamAttr inside a PipelinedStack block "
+                    "is not supported (the per-stage stacked dim would "
+                    "need stage-wise norms) — normalize outside the "
+                    "stack or use a plain ParamAttr")
+            return self._weight_normalize(attr, p, sp, startup_block)
         return p
+
+    def _weight_normalize(self, attr, v, sv, startup_block):
+        """Reparameterize v as w = g * v / ||v|| (reference:
+        layer_helper.py __weight_normalize). v keeps its initializer;
+        g is a trainable per-slice (or scalar) magnitude initialized
+        by STARTUP ops to ||v_init||, so w starts equal to v's init.
+        Returns the composed w variable — gradients flow to v and g
+        through the composition."""
+        shape = list(v.shape)
+        dim = attr.dim
+        if dim is not None and not (0 <= dim < len(shape)):
+            raise ValueError(
+                f"WeightNormParamAttr.dim={dim} out of range for "
+                f"shape {shape}")
+        red_axes = [i for i in range(len(shape)) if i != dim] \
+            if dim is not None else list(range(len(shape)))
+        g_shape = [shape[dim]] if dim is not None else [1]
+        g_name = f"{v.name}@wn.g"
+
+        def norm_ops(block, src, dst_shape, keep_dim):
+            sq = block.create_var(name=unique_name(f"{v.name}@wn.sq"),
+                                  shape=list(src.shape), dtype=v.dtype)
+            block.append_op("square", {"X": src}, {"Out": sq}, {})
+            ssum = block.create_var(name=unique_name(f"{v.name}@wn.ss"),
+                                    shape=dst_shape, dtype=v.dtype)
+            block.append_op("reduce_sum", {"X": sq}, {"Out": ssum},
+                            {"dim": red_axes, "keep_dim": keep_dim,
+                             "reduce_all": dim is None})
+            nrm = block.create_var(name=unique_name(f"{v.name}@wn.n"),
+                                   shape=dst_shape, dtype=v.dtype)
+            block.append_op("sqrt", {"X": ssum}, {"Out": nrm}, {})
+            return nrm
+
+        # startup: g := ||v_init|| (same reduction, flat g shape)
+        sg = startup_block.create_parameter(
+            name=g_name, shape=g_shape, dtype=v.dtype,
+            trainable=attr.trainable)
+        s_nrm = norm_ops(startup_block, sv, g_shape, keep_dim=False)
+        startup_block.append_op("reshape", {"X": s_nrm}, {"Out": sg},
+                                {"shape": g_shape})
+        # main: g as trainable parameter, w composed from (v, g)
+        main_global = self.block.program.global_block()
+        g = main_global.create_parameter(
+            name=g_name, shape=g_shape, dtype=v.dtype,
+            trainable=attr.trainable, regularizer=attr.regularizer)
+        g.optimize_attr = {"learning_rate": attr.learning_rate}
+        keep_shape = [1 if i in red_axes else shape[i]
+                      for i in range(len(shape))]
+        m_nrm = norm_ops(main_global, v, keep_shape, keep_dim=True)
+        unit = main_global.create_var(
+            name=unique_name(f"{v.name}@wn.u"), shape=shape,
+            dtype=v.dtype)
+        main_global.append_op("elementwise_div", {"X": v, "Y": m_nrm},
+                              {"Out": unit}, {"axis": -1})
+        w = main_global.create_var(
+            name=unique_name(f"{v.name}@wn.w"), shape=shape,
+            dtype=v.dtype)
+        main_global.append_op(
+            "elementwise_mul", {"X": unit, "Y": g}, {"Out": w},
+            {"axis": -1 if dim is None else int(dim)})
+        return w
 
     def create_tmp_variable(self, dtype, lod_level: int = 0,
                             shape=None) -> framework.Variable:

@@ -1,0 +1,6 @@
+"""Module-path parity shim (reference: python/paddle/fluid/param_attr.py
+— users import `fluid.param_attr.ParamAttr`). The class itself lives in
+layer_helper.py next to its consumer."""
+from .layer_helper import ParamAttr  # noqa: F401
+
+__all__ = ["ParamAttr"]

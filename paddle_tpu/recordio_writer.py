@@ -1,0 +1,107 @@
+"""Recordio feed converters (reference:
+python/paddle/fluid/recordio_writer.py — convert_reader_to_recordio_file
+serializes each feeded batch as one record). The chunked record format
+itself lives in recordio.py (Writer/Scanner + the native loader); here
+each record is a pickled {var_name: numpy-or-ragged} feed dict, and
+`read_recordio_feeds` yields them back ready for Executor.run."""
+from __future__ import annotations
+
+import pickle
+from typing import Iterator, List
+
+import numpy as np
+
+from .recordio import Scanner, Writer, write_recordio  # noqa: F401
+
+__all__ = ["Writer", "write_recordio",
+           "convert_reader_to_recordio_file",
+           "convert_reader_to_recordio_files", "read_recordio_feeds"]
+
+
+def _to_portable(value):
+    """Feed value -> picklable host form (ragged pairs/trees become
+    plain numpy tuples)."""
+    from .core.lod import RaggedNested, RaggedPair, RaggedTree
+    if isinstance(value, RaggedPair):
+        return ("ragged", np.asarray(value.data),
+                np.asarray(value.lengths))
+    if isinstance(value, RaggedNested):
+        return ("ragged2", np.asarray(value.data),
+                np.asarray(value.sub_lengths),
+                np.asarray(value.tok_lengths))
+    if isinstance(value, RaggedTree):
+        return ("raggedk", np.asarray(value.data),
+                [np.asarray(l) for l in value.lengths])
+    return np.asarray(value)
+
+
+def _from_portable(value):
+    from .core.lod import RaggedNested, RaggedPair, RaggedTree
+    if isinstance(value, tuple) and value and value[0] == "ragged":
+        return RaggedPair(value[1], value[2])
+    if isinstance(value, tuple) and value and value[0] == "ragged2":
+        return RaggedNested(value[1], value[2], value[3])
+    if isinstance(value, tuple) and value and value[0] == "raggedk":
+        return RaggedTree(value[1], tuple(value[2]))
+    return value
+
+
+def convert_reader_to_recordio_file(filename: str, reader_creator,
+                                    feeder, max_num_records: int = 1000,
+                                    feed_order=None) -> int:
+    """Feed every batch from `reader_creator()` through `feeder` and
+    write one record per batch; returns the record count (reference
+    recordio_writer.py:20)."""
+    records = []
+    for batch in reader_creator():
+        feed = feeder.feed(batch)
+        if feed_order is not None:
+            feed = {k: feed[k] for k in feed_order}
+        records.append(pickle.dumps(
+            {k: _to_portable(v) for k, v in feed.items()}))
+        if len(records) >= max_num_records:
+            break
+    write_recordio(records, filename)
+    return len(records)
+
+
+def convert_reader_to_recordio_files(filename: str, batch_per_file: int,
+                                     reader_creator, feeder,
+                                     max_num_records: int = 1000,
+                                     feed_order=None) -> List[str]:
+    """Multi-file variant: rotate to `filename-00000`, `-00001`, ...
+    every `batch_per_file` records (reference recordio_writer.py:46)."""
+    paths: List[str] = []
+    records = []
+
+    def flush():
+        if not records:
+            return
+        path = f"{filename}-{len(paths):05d}"
+        write_recordio(records, path)
+        paths.append(path)
+        records.clear()
+
+    n = 0
+    for batch in reader_creator():
+        feed = feeder.feed(batch)
+        if feed_order is not None:
+            feed = {k: feed[k] for k in feed_order}
+        records.append(pickle.dumps(
+            {k: _to_portable(v) for k, v in feed.items()}))
+        n += 1
+        if len(records) >= batch_per_file:
+            flush()
+        if n >= max_num_records:
+            break
+    flush()
+    return paths
+
+
+def read_recordio_feeds(path: str) -> Iterator[dict]:
+    """Yield the feed dicts a converter wrote — directly usable as
+    Executor.run(feed=...)."""
+    scanner = Scanner(path)
+    for rec in scanner:
+        yield {k: _from_portable(v)
+               for k, v in pickle.loads(rec).items()}
