@@ -434,3 +434,9 @@ def reset_default_programs():
     _main_program = Program()
     _startup_program = Program()
     _name_gen.reset()
+    # in-graph reader registrations are program-scoped build-time state
+    try:
+        from .ops.reader_ops import reset_readers
+        reset_readers()
+    except ImportError:   # during partial package init
+        pass

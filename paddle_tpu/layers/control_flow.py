@@ -17,7 +17,7 @@ from ..layer_helper import LayerHelper
 __all__ = ["StaticRNN", "DynamicRNN", "IfElse", "While", "Switch",
            "PipelinedStack",
            "increment_shared", "array_write", "array_read", "array_length",
-           "less_than_v", "cond_op"]
+           "create_array", "less_than_v", "cond_op"]
 
 
 class StaticRNN:
@@ -473,6 +473,19 @@ def increment_shared(x, value=1.0):
     return increment(x, value)
 
 
+def create_array(dtype, capacity=None):
+    """Declare an empty TensorArray for array_write (reference:
+    layers/control_flow.py create_array creating a LOD_TENSOR_ARRAY
+    var). The array materializes at its first write; `capacity` fixes
+    the dense backing size then."""
+    helper = LayerHelper("create_array")
+    arr = helper.create_tmp_variable(dtype)
+    arr.desc.type = "tensor_array"
+    arr._is_fresh_array = True
+    arr._fresh_capacity = capacity
+    return arr
+
+
 def array_write(x, i, array=None, capacity=None):
     """TensorArray write (reference: tensor_array_read_write_op.cc).
     Arrays are dense [capacity, ...] tensors with dynamic_update_slice.
@@ -483,7 +496,12 @@ def array_write(x, i, array=None, capacity=None):
     helper = LayerHelper("array_write")
     inputs = {"X": x, "I": i}
     attrs = {}
-    if array is None:
+    if array is not None and getattr(array, "_is_fresh_array", False):
+        # declared by create_array, not yet written: this write creates
+        # the backing tensor in the declared var
+        attrs["capacity"] = (capacity or array._fresh_capacity or 128)
+        array._is_fresh_array = False
+    elif array is None:
         array = helper.create_tmp_variable(x.dtype)
         array.desc.type = "tensor_array"
         attrs["capacity"] = capacity if capacity is not None else 128

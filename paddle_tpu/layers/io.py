@@ -1,7 +1,17 @@
-"""Input layers (reference: python/paddle/fluid/layers/io.py — data:29)."""
+"""Input layers (reference: python/paddle/fluid/layers/io.py — data:29,
+open_recordio_file:287, read_file, and the decorated readers). In-graph
+readers follow the CSP-channel pattern: host-side iterator state,
+ordered io_callback reads — see ops/reader_ops.py."""
 from __future__ import annotations
 
+import random as _random
+
 from ..framework import default_main_program, default_startup_program
+from ..layer_helper import LayerHelper
+
+__all__ = ["data", "open_recordio_file", "open_files", "read_file",
+           "create_shuffle_reader", "create_double_buffer_reader",
+           "create_multi_pass_reader"]
 
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
@@ -16,3 +26,154 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
         name=name, shape=shape, dtype=dtype, lod_level=lod_level,
         stop_gradient=stop_gradient)
     return var
+
+
+class _ReaderHandle:
+    """Build-time handle for an in-graph reader: the registered host
+    reader id plus the static batch schema read_file bakes into the
+    program."""
+
+    def __init__(self, reader_id, var_names, shapes, dtypes):
+        self.reader_id = int(reader_id)
+        self.var_names = list(var_names)
+        self.shapes = [list(s) for s in shapes]
+        self.dtypes = list(dtypes)
+
+    def _wrap(self, make_iter):
+        from ..ops.reader_ops import register_reader
+        return _ReaderHandle(register_reader(make_iter), self.var_names,
+                             self.shapes, self.dtypes)
+
+    def close(self):
+        """Unregister the host reader (a decorator chain's handles are
+        independent registrations; close each, or rely on
+        reset_default_programs clearing the registry)."""
+        from ..ops.reader_ops import unregister_reader
+        unregister_reader(self.reader_id)
+
+
+def _reader_schema(first_file, shapes, dtypes, var_names, caller):
+    """Shared schema validation for the open_* readers."""
+    from ..recordio_writer import read_recordio_feeds
+    if var_names is None:
+        probe = next(iter(read_recordio_feeds(first_file)))
+        var_names = list(probe.keys())
+    if len(var_names) != len(shapes) or len(shapes) != len(dtypes):
+        raise ValueError(
+            f"{caller}: {len(var_names)} vars vs {len(shapes)} shapes "
+            f"vs {len(dtypes)} dtypes")
+    return var_names
+
+
+def open_recordio_file(filename, shapes, dtypes, lod_levels=None,
+                       var_names=None):
+    """In-graph reader over a recordio feed file (reference:
+    layers/io.py open_recordio_file over
+    operators/reader/create_recordio_file_reader_op.cc). The file holds
+    the records recordio_writer.convert_reader_to_recordio_file wrote;
+    `shapes`/`dtypes` declare the static per-batch schema, `var_names`
+    the record keys (defaults to the record's own key order)."""
+    from ..ops.reader_ops import register_reader
+    from ..recordio_writer import read_recordio_feeds
+
+    var_names = _reader_schema(filename, shapes, dtypes, var_names,
+                               "open_recordio_file")
+    rid = register_reader(lambda: read_recordio_feeds(filename))
+    return _ReaderHandle(rid, var_names, shapes, dtypes)
+
+
+def open_files(filenames, shapes, dtypes, lod_levels=None,
+               var_names=None):
+    """Multi-file variant (reference: layers/io.py open_files): files
+    are read in order, one stream."""
+    from ..recordio_writer import read_recordio_feeds
+
+    if not filenames:
+        raise ValueError("open_files: empty filename list")
+
+    def chain():
+        for fn in filenames:
+            for feed in read_recordio_feeds(fn):
+                yield feed
+
+    var_names = _reader_schema(filenames[0], shapes, dtypes, var_names,
+                               "open_files")
+    from ..ops.reader_ops import register_reader
+    rid = register_reader(chain)
+    return _ReaderHandle(rid, var_names, shapes, dtypes)
+
+
+def read_file(reader: _ReaderHandle):
+    """Append a read op: returns one program variable per declared var,
+    filled with the next batch each execution (reference read_file over
+    read_op.cc). Reads keep program order (ordered callback)."""
+    helper = LayerHelper("read_file")
+    rid_var = helper.create_tmp_variable("int32", shape=[])
+    helper.append_op(type="fill_constant", inputs={},
+                     outputs={"Out": rid_var},
+                     attrs={"shape": [], "dtype": "int32",
+                            "value": float(reader.reader_id)})
+    outs = [helper.create_variable(
+        name=f"{helper.name}.{n}", dtype=dt, shape=list(s))
+        for n, s, dt in zip(reader.var_names, reader.shapes,
+                            reader.dtypes)]
+    helper.append_op(type="read_file", inputs={"Reader": rid_var},
+                     outputs={"Out": outs},
+                     attrs={"var_names": reader.var_names,
+                            "shapes": reader.shapes,
+                            "dtypes": reader.dtypes})
+    return outs if len(outs) != 1 else outs[0]
+
+
+def create_shuffle_reader(reader: _ReaderHandle, buffer_size: int,
+                          seed=None):
+    """Buffered-shuffle decorator (reference:
+    create_shuffle_reader_op.cc): fill a host buffer, yield shuffled."""
+    inner = reader
+
+    def make_iter():
+        rng = _random.Random(seed)
+        from ..ops.reader_ops import get_reader
+        src = get_reader(inner.reader_id).make_iter()
+        buf = []
+        for feed in src:
+            buf.append(feed)
+            if len(buf) >= buffer_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        rng.shuffle(buf)
+        yield from buf
+
+    return reader._wrap(make_iter)
+
+
+def create_double_buffer_reader(reader: _ReaderHandle, place=None):
+    """Prefetch decorator (reference:
+    create_double_buffer_reader_op.cc): a background thread keeps the
+    next batches ready while the program computes."""
+    from ..reader import buffered as _buffered
+
+    inner = reader
+
+    def make_iter():
+        from ..ops.reader_ops import get_reader
+        return _buffered(lambda: get_reader(inner.reader_id).make_iter(),
+                         size=2)()
+
+    return reader._wrap(make_iter)
+
+
+def create_multi_pass_reader(reader: _ReaderHandle, pass_num: int):
+    """Epoch-loop decorator (reference:
+    create_multi_pass_reader_op.cc): replay the underlying stream
+    `pass_num` times before exhausting."""
+    inner = reader
+
+    def make_iter():
+        from ..ops.reader_ops import get_reader
+        for _ in range(int(pass_num)):
+            for feed in get_reader(inner.reader_id).make_iter():
+                yield feed
+
+    return reader._wrap(make_iter)

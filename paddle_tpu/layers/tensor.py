@@ -14,11 +14,12 @@ from ..layer_helper import LayerHelper
 
 __all__ = [
     "create_tensor", "create_parameter", "create_global_var", "cast",
-    "concat", "sums", "assign", "fill_constant", "fill_constant_batch_size_like",
+    "concat", "sums", "sum", "assign", "fill_constant",
+    "fill_constant_batch_size_like",
     "ones", "zeros", "reverse", "reshape", "transpose", "split", "squeeze",
     "unsqueeze", "stack", "expand", "gather", "scatter", "pad", "one_hot",
     "argmax", "argmin", "shape", "range", "linspace", "zeros_like",
-    "ones_like", "diag", "eye", "slice",
+    "ones_like", "diag", "eye", "slice", "Print",
 ]
 
 
@@ -65,8 +66,11 @@ def concat(input: Sequence[Variable], axis: int = 0, name=None):
         ax = axis if axis >= 0 else len(shape) + axis
         if 0 <= ax < len(shape):
             dims = [v.shape[ax] for v in input]
+            # builtins.sum: the module-level `sum = sums` layer alias
+            # (reference API parity) shadows the builtin here
+            import builtins
             shape[ax] = -1 if any(d is None or d < 0 for d in dims) \
-                else sum(dims)
+                else builtins.sum(dims)
         else:
             # Declared shapes are loose metadata (ragged vars declare 2D);
             # leave it to the runtime op when the axis is out of range.
@@ -84,6 +88,28 @@ def sums(input: Sequence[Variable], out=None):
     out = out or helper.create_tmp_variable(input[0].dtype)
     helper.append_op(type="sum", inputs={"X": list(input)},
                      outputs={"Out": out})
+    return out
+
+
+# reference layers/ops.py exports `sum` (same op) alongside `sums`
+sum = sums  # noqa: A001
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,  # noqa: N802
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Debug-print a tensor from inside the compiled program
+    (reference: layers/control_flow.py Print over print_op.cc; the
+    formatting knobs are accepted for API parity — jax.debug.print
+    renders the value)."""
+    helper = LayerHelper("print")
+    out = helper.create_tmp_variable(input.dtype,
+                                     lod_level=input.lod_level,
+                                     shape=input.shape)
+    helper.append_op(type="print", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"message": message or input.name})
     return out
 
 

@@ -22,3 +22,4 @@ from ..core.registry import OpRegistry
 def all_ops():
     return OpRegistry.all_ops()
 from . import csp_ops  # noqa: F401
+from . import reader_ops  # noqa: F401

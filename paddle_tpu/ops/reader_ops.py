@@ -1,0 +1,117 @@
+"""In-graph file readers (reference: operators/reader/* —
+create_recordio_file_reader, read_file, and the shuffle/double-buffer/
+multi-pass decorator readers, surfaced as fluid.layers.io functions).
+
+TPU-native form mirrors the CSP channel design: reader STATE lives on
+the host (an iterator over feed dicts, e.g. the records
+recordio_writer.convert_reader_to_recordio_file wrote); the in-graph
+`read_file` op pulls the next batch through an ordered
+`jax.experimental.io_callback`, so reads keep program order and the
+batch enters the compiled program as statically-shaped tensors.
+Exhaustion raises StopIteration on the host, surfacing as an error
+from Executor.run — the reference's reader EOF contract; wrap with a
+multi-pass reader for epoch loops.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .core_ops import jnp_dtype
+
+_readers: Dict[int, "_HostReader"] = {}
+_lock = threading.Lock()
+_next_id = [1]
+
+
+class _HostReader:
+    """A restartable host iterator of feed dicts."""
+
+    def __init__(self, make_iter: Callable):
+        self.make_iter = make_iter
+        self._it = None
+
+    def next(self):
+        if self._it is None:
+            self._it = iter(self.make_iter())
+        try:
+            return next(self._it)
+        except StopIteration:
+            self._it = None      # next read starts a fresh pass
+            raise
+
+    def reset(self):
+        self._it = None
+
+
+def register_reader(make_iter: Callable) -> int:
+    with _lock:
+        rid = _next_id[0]
+        _next_id[0] += 1
+        _readers[rid] = _HostReader(make_iter)
+    return rid
+
+
+def unregister_reader(rid: int) -> None:
+    with _lock:
+        _readers.pop(int(rid), None)
+
+
+def reset_readers() -> None:
+    """Drop every registered host reader. Reader registrations are
+    program-scoped build-time state (unlike channels, whose lifetime
+    signal is close); framework.reset_default_programs calls this so a
+    long-lived session rebuilding programs does not accumulate reader
+    closures and live iterators."""
+    with _lock:
+        _readers.clear()
+
+
+def get_reader(rid: int) -> _HostReader:
+    with _lock:
+        r = _readers.get(int(rid))
+    if r is None:
+        raise KeyError(f"unknown reader id {rid}")
+    return r
+
+
+def _host_read(rid, *, names, shapes, dtypes):
+    feed = get_reader(int(rid)).next()
+    out = []
+    for name, shape, dtype in zip(names, shapes, dtypes):
+        if name not in feed:
+            raise KeyError(
+                f"read_file: record has no var {name!r}; record keys: "
+                f"{sorted(feed)}")
+        arr = np.asarray(feed[name]).astype(dtype, copy=False)
+        if tuple(arr.shape) != tuple(shape):
+            raise ValueError(
+                f"read_file: var {name!r} has shape {arr.shape}, "
+                f"reader declared {tuple(shape)}")
+        out.append(arr)
+    return tuple(out)
+
+
+@register_op("read_file", stateful=True, no_grad_slots=["Reader"])
+def _read_file(ctx):
+    import functools
+
+    rid = ctx.input("Reader")
+    names = tuple(ctx.attr("var_names"))
+    shapes = tuple(tuple(int(d) for d in s) for s in ctx.attr("shapes"))
+    # canonicalize (int64 -> int32 without x64): io_callback result
+    # dtypes must match what the program can hold
+    dtypes = tuple(np.dtype(jax.dtypes.canonicalize_dtype(
+        jnp_dtype(d))).name for d in ctx.attr("dtypes"))
+    out_shapes = tuple(jax.ShapeDtypeStruct(s, jnp_dtype(d))
+                       for s, d in zip(shapes, dtypes))
+    res = jax.experimental.io_callback(
+        functools.partial(_host_read, names=names, shapes=shapes,
+                          dtypes=dtypes),
+        out_shapes, jnp.asarray(rid, jnp.int32), ordered=True)
+    ctx.set_outputs("Out", list(res))

@@ -80,3 +80,42 @@ def test_replaced_ops_are_actually_absent():
     ours = set(OpRegistry.all_ops())
     stale = sorted(set(REPLACED) & ours)
     assert not stale, f"REPLACED entries now registered directly: {stale}"
+
+
+def test_reference_layers_all_surface():
+    """Every name in the reference's fluid.layers.__all__ either exists
+    on paddle_tpu.layers or is on the documented-substitution list
+    (PARITY.md op-name notes: nested-Executor machinery subsumed by the
+    masked-scan design, pserver/multi-GPU ops replaced by SPMD, and
+    internal builder guards)."""
+    import os
+    import re
+    from paddle_tpu import layers
+
+    SUBSTITUTED = {
+        # internal graph-builder machinery (not user API capabilities)
+        "BlockGuard", "BlockGuardServ", "BlockGuardWithCompletion",
+        "ConditionalBlock", "StaticRNNMemoryLink", "WhileGuard",
+        "autodoc", "deprecated", "generate_layer_fn",
+        # pserver / multi-GPU graph ops -> SPMD collectives (PARITY N8/N16)
+        "ListenAndServ", "ParallelDo", "Send", "get_places",
+        # LoD nested-Executor machinery -> masked-scan DynamicRNN design
+        "lod_rank_table", "lod_tensor_to_array", "array_to_lod_tensor",
+        "max_sequence_len", "merge_lod_tensor", "split_lod_tensor",
+        "reorder_lod_tensor_by_rank", "shrink_memory",
+        # in-graph mAP op -> host-side metrics.DetectionMAP (PARITY note)
+        "detection_map",
+    }
+    base = "/root/reference/python/paddle/fluid/layers"
+    if not os.path.isdir(base):
+        import pytest
+        pytest.skip("reference tree not mounted")
+    names = set()
+    for fn in os.listdir(base):
+        if fn.endswith(".py"):
+            src = open(os.path.join(base, fn)).read()
+            for m in re.finditer(r"__all__ = \[(.*?)\]", src, re.S):
+                names.update(re.findall(r"'(\w+)'", m.group(1)))
+    missing = sorted(n for n in names
+                     if n not in SUBSTITUTED and not hasattr(layers, n))
+    assert not missing, missing

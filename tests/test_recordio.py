@@ -122,3 +122,87 @@ def test_convert_reader_to_recordio_file_roundtrip(tmp_path):
         str(tmp_path / "multi"), 2, reader, feeder)
     assert len(paths) == 2                    # 3 batches, 2 per file
     assert sum(len(list(read_recordio_feeds(p))) for p in paths) == 3
+
+
+def test_in_graph_reader_pipeline(tmp_path):
+    """fluid in-graph readers (reference: layers/io.py
+    open_recordio_file/read_file + shuffle/double-buffer/multi-pass
+    decorators over operators/reader/*): the program PULLS its own
+    batches; reads keep program order; multi-pass replays epochs."""
+    import numpy as np
+    import pytest
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.recordio_writer import convert_reader_to_recordio_file
+
+    pt.reset_default_programs()
+    build_main, build_startup = pt.Program(), pt.Program()
+    with pt.program_guard(build_main, build_startup):
+        x = layers.data("rx", [2], dtype="float32")
+        y = layers.data("ry", [1], dtype="int64")
+    feeder = pt.DataFeeder(feed_list=[x, y], place=pt.CPUPlace())
+
+    batches = [[(np.full(2, i, np.float32), i), (np.full(2, i, np.float32), i)]
+               for i in range(4)]
+    path = str(tmp_path / "in_graph.recordio")
+    assert convert_reader_to_recordio_file(path, lambda: iter(batches),
+                                           feeder) == 4
+
+    pt.reset_default_programs()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        reader = layers.open_recordio_file(
+            path, shapes=[[2, 2], [2, 1]],
+            dtypes=["float32", "int64"])
+        reader = layers.create_multi_pass_reader(reader, pass_num=2)
+        rx, ry = layers.read_file(reader)
+        out = layers.scale(rx, scale=10.0)
+    exe = pt.Executor()
+    exe.run(startup)
+    seen = []
+    for _ in range(8):                      # 4 batches x 2 passes
+        (ov,) = exe.run(main, fetch_list=[out])
+        seen.append(float(np.asarray(ov)[0, 0]))
+    assert seen == [0.0, 10.0, 20.0, 30.0] * 2
+    # 9th read exhausts the two passes
+    with pytest.raises(Exception):
+        exe.run(main, fetch_list=[out])
+
+    # shuffle decorator: same multiset of batches, buffered shuffle
+    pt.reset_default_programs()
+    m2, s2 = pt.Program(), pt.Program()
+    with pt.program_guard(m2, s2):
+        r2 = layers.open_recordio_file(
+            path, shapes=[[2, 2], [2, 1]], dtypes=["float32", "int64"])
+        r2 = layers.create_shuffle_reader(r2, buffer_size=4, seed=3)
+        r2 = layers.create_double_buffer_reader(r2)
+        rx2, _ry2 = layers.read_file(r2)
+    e2 = pt.Executor()
+    e2.run(s2)
+    got = sorted(float(np.asarray(e2.run(m2, fetch_list=[rx2])[0])[0, 0])
+                 for _ in range(4))
+    assert got == [0.0, 1.0, 2.0, 3.0]
+
+
+def test_create_array_and_print_layers():
+    """create_array + array_write/read; Print passes through."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.layers import control_flow as cf
+
+    pt.reset_default_programs()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        arr = cf.create_array("float32", capacity=4)
+        v = layers.fill_constant([2], "float32", 5.0)
+        i = layers.fill_constant([], "int64", 1)
+        arr = cf.array_write(v, i, array=arr)
+        got = cf.array_read(arr, i)
+        printed = layers.Print(got, message="dbg")
+        s = layers.sum([got, printed])
+    exe = pt.Executor()
+    exe.run(startup)
+    gv, sv = exe.run(main, fetch_list=[got, s])
+    np.testing.assert_allclose(np.asarray(gv), 5.0)
+    np.testing.assert_allclose(np.asarray(sv), 10.0)
