@@ -330,9 +330,10 @@ def bench_mnist(pt):
     """MNIST conv training (BASELINE config 1; tests/book
     recognize_digits)."""
     from paddle_tpu.models import mnist
+    # ~2ms steps: very long windows or the spread is all tunnel jitter
     return _bench_image_model(
         pt, mnist.build_train, 512, (1, 28, 28), 10,
-        n1=20, n2=120, repeats=3)
+        n1=60, n2=360, repeats=3)
 
 
 def bench_deepfm(pt):
@@ -354,7 +355,7 @@ def bench_deepfm(pt):
     for v in feed.values():
         v.flags.writeable = False
     sps, spread = _marginal_steps_per_sec(exe, main_p, feed, f["loss"],
-                                          n1=20, n2=120, repeats=3)
+                                          n1=60, n2=360, repeats=3)
     return b * sps, spread
 
 
@@ -415,7 +416,7 @@ def bench_lstm_lm(pt):
     # LSTM steps are ~ms-scale: use longer runs so the marginal delta
     # dwarfs tunnel jitter
     sps, spread = _marginal_steps_per_sec(exe, main_p, feed, f["loss"],
-                                          n1=20, n2=120, repeats=3)
+                                          n1=40, n2=240, repeats=3)
     return b * t * sps, spread
 
 
