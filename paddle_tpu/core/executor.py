@@ -177,6 +177,7 @@ def trace_block(block: BlockDesc, env: Dict[str, Any],
     this keeps the lowering from pinning dead values). Vars in
     extra["keep_vars"] (fetches + state writes) always survive."""
     keep = extra.get("keep_vars") or ()
+    stats = extra.get("trace_stats")  # optional {.. -> peak_env_bytes}
     for op in block.ops:
         env.update(run_op(op, env, extra))
         dead = op.attrs.get("__dead_vars__")
@@ -184,6 +185,15 @@ def trace_block(block: BlockDesc, env: Dict[str, Any],
             for name in dead:
                 if name not in keep:
                     env.pop(name, None)
+        if stats is not None:
+            live = 0
+            for v in env.values():
+                size = getattr(v, "size", None)
+                dt = getattr(v, "dtype", None)
+                if size is not None and dt is not None:
+                    live += int(size) * np.dtype(dt).itemsize
+            stats["peak_env_bytes"] = max(
+                stats.get("peak_env_bytes", 0), live)
     return env
 
 
@@ -467,7 +477,7 @@ class Executor:
         """
         if hasattr(program, "desc"):  # accept the python builder wrapper
             program = program.desc
-        scope = scope or global_scope()
+        scope = global_scope() if scope is None else scope
         feed = feed or {}
         fetch_names = [f if isinstance(f, str) else f.name
                        for f in (fetch_list or [])]

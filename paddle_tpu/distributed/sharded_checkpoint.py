@@ -55,7 +55,7 @@ def save_sharded(dirname: str, names=None, scope=None) -> str:
     """Each process writes `shard_<pid>.npz` holding the array pieces it
     owns (replica 0 of each distinct shard); process 0 writes
     `index.json` (var -> shape/dtype/piece map + per-file md5s)."""
-    scope = scope or global_scope()
+    scope = global_scope() if scope is None else scope
     if names is None:
         names = list(scope.local_names())
     os.makedirs(dirname, exist_ok=True)
@@ -132,7 +132,7 @@ def load_sharded(dirname: str,
     (per-process pieces must match the saved layout); others load as
     host numpy arrays (from their saved pieces, which must cover the
     full array on some single file — i.e. replicated saves)."""
-    scope = scope or global_scope()
+    scope = global_scope() if scope is None else scope
     shardings = shardings or {}
     with open(os.path.join(dirname, "index.json")) as f:
         meta = json.load(f)

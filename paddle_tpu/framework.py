@@ -38,6 +38,22 @@ def unique_name(key: str) -> str:
     return _name_gen(key)
 
 
+@contextlib.contextmanager
+def isolated_name_scope():
+    """Run a graph build with a FRESH name counter, restoring the
+    global one afterwards — gives deterministic auto names to builders
+    that must lower the same graph identically more than once (the v2
+    Topology lowers per-use: train, test, and infer programs must all
+    name 'fc_0.w_0' the same). Vars live in separate Program objects,
+    so equal names across programs cannot collide."""
+    saved = _name_gen.ids
+    _name_gen.ids = {}
+    try:
+        yield
+    finally:
+        _name_gen.ids = saved
+
+
 class Variable:
     """User-facing handle to a VarDesc inside a Block."""
 

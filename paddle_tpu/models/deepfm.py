@@ -11,18 +11,26 @@ from .. import layers, optimizer as opt
 
 
 def deepfm(feat_ids, feat_vals, label, num_features=int(1e5), embed_dim=8,
-           layer_sizes=(400, 400, 400)):
-    """feat_ids: [b, f, 1] int64; feat_vals: [b, f]; label [b, 1]."""
+           layer_sizes=(400, 400, 400), distributed=False):
+    """feat_ids: [b, f, 1] int64; feat_vals: [b, f]; label [b, 1].
+
+    distributed=True row-shards both embedding tables over the mesh's
+    'model' axis (parallel/sparse.sharded_lookup) — the EP layout the
+    reference serves via its distributed lookup table design
+    (doc/fluid/design/dist_train/distributed_lookup_table_design.md).
+    """
     num_fields = int(feat_ids.shape[1])
 
     # ---- first order: w_i * x_i
-    w1 = layers.embedding(feat_ids, size=[num_features, 1])  # [b, f, 1]
+    w1 = layers.embedding(feat_ids, size=[num_features, 1],
+                          is_distributed=distributed)  # [b, f, 1]
     first = layers.reduce_sum(
         layers.elementwise_mul(layers.reshape(w1, [0, num_fields]),
                                feat_vals), dim=1, keep_dim=True)
 
     # ---- second order (FM): 0.5 * ((sum v x)^2 - sum (v x)^2)
-    emb = layers.embedding(feat_ids, size=[num_features, embed_dim])
+    emb = layers.embedding(feat_ids, size=[num_features, embed_dim],
+                           is_distributed=distributed)
     vals = layers.reshape(feat_vals, [0, num_fields, 1])
     vx = layers.elementwise_mul(emb, vals)          # [b, f, k]
     sum_vx = layers.reduce_sum(vx, dim=1)           # [b, k]
@@ -46,7 +54,8 @@ def deepfm(feat_ids, feat_vals, label, num_features=int(1e5), embed_dim=8,
     return pred, loss
 
 
-def build_train(num_features=int(1e5), num_fields=39, embed_dim=8, lr=1e-3):
+def build_train(num_features=int(1e5), num_fields=39, embed_dim=8, lr=1e-3,
+                distributed=False):
     import paddle_tpu as pt
     main, startup = pt.Program(), pt.Program()
     with pt.program_guard(main, startup):
@@ -55,6 +64,6 @@ def build_train(num_features=int(1e5), num_fields=39, embed_dim=8, lr=1e-3):
                                 dtype="float32")
         label = layers.data("label", [1], dtype="float32")
         pred, loss = deepfm(feat_ids, feat_vals, label, num_features,
-                            embed_dim)
+                            embed_dim, distributed=distributed)
         opt.AdamOptimizer(learning_rate=lr).minimize(loss)
     return main, startup, {"loss": loss, "pred": pred}

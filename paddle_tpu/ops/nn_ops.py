@@ -581,7 +581,8 @@ def _lookup_table(ctx):
         from ..parallel.sparse import sharded_lookup
         out = sharded_lookup(w, ids32,
                              axis=ctx.attr("shard_axis", "model"),
-                             mesh=ctx.extra["mesh"])
+                             mesh=ctx.extra["mesh"],
+                             batch_axis=ctx.extra.get("feed_axis"))
     else:
         # explicit clip: jnp.take's default OOB mode is NaN-fill, and
         # the sharded path clips — keep the two paths identical

@@ -59,7 +59,7 @@ class Optimizer:
             return
         import jax.numpy as jnp
         from .core.scope import global_scope
-        scope = scope or global_scope()
+        scope = global_scope() if scope is None else scope
         scope.set(self._lr_var.name,
                   jnp.asarray([float(self._lr(step))], jnp.float32))
 

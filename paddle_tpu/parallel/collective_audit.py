@@ -13,7 +13,6 @@ loses its gradient all-reduce or its ring permute fails loudly.
 """
 from __future__ import annotations
 
-import itertools
 import re
 from typing import Dict, List, Optional, Tuple
 
@@ -106,57 +105,47 @@ def parse_collectives(hlo_text: str) -> List[Collective]:
     return out
 
 
-def _axis_partitions(mesh) -> Dict[Tuple[str, ...], set]:
-    """For every non-empty subset of mesh axes: the partition of linear
-    device indices obtained by varying exactly those axes (as a set of
-    frozensets)."""
+def classify(collectives: List[Collective], mesh) -> List[Collective]:
+    """Tag each collective with the mesh-axis subset it communicates
+    over: the set of axes whose device coordinate VARIES within a
+    replica group (for grouped collectives) or DIFFERS between source
+    and target of a non-self pair (for permutes). This attributes
+    every well-formed collective — including composite-axis permutes
+    such as GSPMD resharding swaps between two axes (pairs differing
+    in both coordinates) and halo exchanges with identity self-pairs.
+    Collectives that move nothing across chips (all self-pairs /
+    singleton groups) are tagged ("local",)."""
     names = list(mesh.axis_names)
     shape = [mesh.shape[n] for n in names]
-    idx = np.arange(int(np.prod(shape))).reshape(shape)
-    parts = {}
-    for r in range(1, len(names) + 1):
-        for combo in itertools.combinations(range(len(names)), r):
-            other = [i for i in range(len(names)) if i not in combo]
-            moved = np.moveaxis(idx, combo, range(len(combo)))
-            flat = moved.reshape(
-                int(np.prod([shape[i] for i in combo])), -1)
-            groups = {frozenset(int(v) for v in flat[:, j])
-                      for j in range(flat.shape[1])}
-            parts[tuple(names[i] for i in combo)] = groups
-    return parts
+    n_dev = int(np.prod(shape))
+    coords = {i: np.unravel_index(i, shape) for i in range(n_dev)}
 
+    def _order(axset) -> Tuple[str, ...]:
+        return tuple(n for n in names if n in axset)
 
-def classify(collectives: List[Collective], mesh) -> List[Collective]:
-    """Tag each collective with the mesh-axis subset its groups span."""
-    parts = _axis_partitions(mesh)
-    n_dev = int(np.prod([mesh.shape[n] for n in mesh.axis_names]))
     for c in collectives:
+        varying = set()
         if c.groups:
-            got = {frozenset(g) for g in c.groups}
-            if got == {frozenset(range(n_dev))} and \
-                    len(mesh.axis_names) > 1:
-                c.axes = tuple(mesh.axis_names)
-                continue
-            for axes, groups in parts.items():
-                if got == groups:
-                    c.axes = axes
-                    break
+            for g in c.groups:
+                if len(g) < 2:
+                    continue
+                base = coords[g[0]]
+                for dev in g[1:]:
+                    for ai, name in enumerate(names):
+                        if coords[dev][ai] != base[ai]:
+                            varying.add(name)
+            c.axes = _order(varying) if varying else ("local",)
         elif c.pairs:
-            # a permute belongs to axis a if every (src, dst) differs
-            # in exactly the a-coordinate (ring/neighbor exchange)
-            names = list(mesh.axis_names)
-            shape = [mesh.shape[n] for n in names]
-            coords = {i: np.unravel_index(i, shape)
-                      for i in range(n_dev)}
-            for ai, name in enumerate(names):
-                ok = all(
-                    all(coords[s][j] == coords[d][j]
-                        for j in range(len(names)) if j != ai)
-                    and coords[s][ai] != coords[d][ai]
-                    for s, d in c.pairs)
-                if ok and c.pairs:
-                    c.axes = (name,)
-                    break
+            for s, d in c.pairs:
+                if s == d:
+                    continue
+                for ai, name in enumerate(names):
+                    if coords[s][ai] != coords[d][ai]:
+                        varying.add(name)
+            c.axes = _order(varying) if varying else ("local",)
+        elif c.kind != "collective-permute":
+            # replica_groups={} (or absent): one group of ALL devices
+            c.axes = tuple(names)
     return collectives
 
 
@@ -180,18 +169,64 @@ def format_inventory(inv) -> str:
     return "\n".join(lines) if lines else "  (no collectives)"
 
 
-def assert_collectives(inv, expectations) -> None:
-    """expectations: list of (kinds, axis) — at least one collective
-    whose kind is in `kinds` and whose axis set CONTAINS `axis` must
-    exist (GSPMD may legally merge axes, e.g. one all-reduce over
-    data+seq for gradients replicated across both)."""
-    for kinds, axis in expectations:
-        hit = any(kind in kinds and axis in axes
-                  for (kind, axes), _ in inv.items())
-        if not hit:
+def axis_bytes(inv, kinds=None) -> Dict[str, int]:
+    """Total estimated bytes per mesh axis (a collective over a
+    composite axis set contributes its bytes to each member axis),
+    optionally restricted to a set of collective kinds."""
+    out: Dict[str, int] = {}
+    for (kind, axes), (_cnt, b) in inv.items():
+        if kinds is not None and kind not in kinds:
+            continue
+        for ax in axes:
+            if ax not in ("?", "local"):
+                out[ax] = out.get(ax, 0) + b
+    return out
+
+
+def assert_collectives(inv, expectations, forbid=()) -> None:
+    """expectations: list of (kinds, axis) or (kinds, axis, min_bytes)
+    — at least one collective whose kind is in `kinds` and whose axis
+    set CONTAINS `axis` must exist (GSPMD may legally merge axes, e.g.
+    one all-reduce over data+seq for gradients replicated across
+    both); with min_bytes, the summed bytes of the matching rows must
+    reach it (per-axis byte accounting, not just presence).
+
+    `forbid`: list of (kinds, axis) that must NOT appear — rejects a
+    misrouted layout (e.g. a ring permute landing on the wrong axis).
+
+    Any row the classifier could not attribute (axes == ("?",)) fails
+    the audit unconditionally: an unattributed collective is exactly
+    the kind of silent misrouting this audit exists to catch."""
+    unattributed = [(k, cnt, b) for (k, axes), (cnt, b) in inv.items()
+                    if "?" in axes]
+    if unattributed:
+        raise AssertionError(
+            "unattributed collectives in inventory (classifier could "
+            f"not assign mesh axes): {unattributed}\n"
+            + format_inventory(inv))
+    for exp in expectations:
+        kinds, axis = exp[0], exp[1]
+        min_bytes = exp[2] if len(exp) > 2 else None
+        rows = [(cnt, b) for (kind, axes), (cnt, b) in inv.items()
+                if kind in kinds and axis in axes]
+        if not rows:
             raise AssertionError(
                 f"expected a {'/'.join(kinds)} collective over axis "
                 f"{axis!r}; inventory:\n" + format_inventory(inv))
+        if min_bytes is not None:
+            got = sum(b for _c, b in rows)
+            if got < min_bytes:
+                raise AssertionError(
+                    f"{'/'.join(kinds)} over {axis!r}: {got} bytes < "
+                    f"expected minimum {min_bytes}; inventory:\n"
+                    + format_inventory(inv))
+    for kinds, axis in forbid:
+        rows = [(kind, axes) for (kind, axes), _ in inv.items()
+                if kind in kinds and axis in axes]
+        if rows:
+            raise AssertionError(
+                f"forbidden collective present: {rows} over {axis!r}; "
+                "inventory:\n" + format_inventory(inv))
 
 
 def compiled_hlo_for(exe, program, scope=None) -> str:
@@ -200,7 +235,7 @@ def compiled_hlo_for(exe, program, scope=None) -> str:
     abstract state the last run used."""
     import jax.numpy as jnp
     import paddle_tpu as pt
-    scope = scope or pt.global_scope()
+    scope = pt.global_scope() if scope is None else scope
     uid = program.desc.uid if hasattr(program, "desc") else program.uid
     entry = next(v for k, v in exe._cache.items() if k[0] == uid)
     raise_if = [n for n in entry.ro_names + entry.rw_names

@@ -145,6 +145,7 @@ class ParallelExecutor(Executor):
                 "program": program,
                 "step": step,
                 "mesh": mesh,
+                "feed_axis": self.sharding.feed_axis,
                 "keep_vars": set(fetch_names) | set(write_names),
                 "prng": lambda seed: jax.random.fold_in(
                     jax.random.PRNGKey(seed), step),

@@ -43,12 +43,21 @@ def table_spec(axis: str = "model") -> P:
 
 
 def sharded_lookup(table, ids, axis: str = "model",
-                   mesh: Optional[Mesh] = None):
-    """Gather rows of a row-sharded table: ids replicated, table
-    P(axis, None). Each shard answers only ids in its own row range;
-    a psum over `axis` assembles the full result. Differentiable —
-    the vjp scatter-adds only into the owning shard (SelectedRows-
-    equivalent sparse update)."""
+                   mesh: Optional[Mesh] = None,
+                   batch_axis: Optional[str] = None):
+    """Gather rows of a row-sharded table: table P(axis, None). Each
+    shard answers only ids in its own row range; a psum over `axis`
+    assembles the full result. Differentiable — the vjp scatter-adds
+    only into the owning shard (SelectedRows-equivalent sparse
+    update).
+
+    batch_axis: mesh axis the ids' LEADING dim is sharded over (the
+    data-parallel feed axis). When given (and the batch divides it),
+    each data row looks up only its own batch shard, so the psum moves
+    b_local x D bytes per chip instead of forcing the ids and result
+    to be batch-GLOBAL (which made GSPMD all-gather the whole batch
+    over the data axis — measured 16.6 MB/step of avoidable traffic
+    in the 8-chip DeepFM audit vs 1.3 MB sharded)."""
     mesh = mesh or get_mesh()
     if mesh is None:
         return jnp.take(table, ids, axis=0, mode="clip")
@@ -68,8 +77,16 @@ def sharded_lookup(table, ids, axis: str = "model",
             f"{axis!r} ({n_shards} shards); pad the table")
     rows_per = vocab // n_shards
 
+    if (batch_axis is not None and batch_axis != axis
+            and batch_axis in mesh.axis_names and ids.ndim >= 1
+            and ids.shape[0] % mesh.shape[batch_axis] == 0):
+        ids_spec = P(batch_axis, *([None] * (ids.ndim - 1)))
+        out_spec = P(batch_axis, *([None] * ids.ndim))
+    else:
+        ids_spec, out_spec = P(), P()
+
     def local_gather(shard, ids_l):
-        # shard: [vocab/n, D]; ids_l: replicated ids
+        # shard: [vocab/n, D]; ids_l: this cell's batch shard
         my = jax.lax.axis_index(axis)
         lo = my * rows_per
         local_ids = ids_l - lo
@@ -81,8 +98,8 @@ def sharded_lookup(table, ids, axis: str = "model",
 
     return shard_map(
         local_gather, mesh=mesh,
-        in_specs=(P(axis, None), P()),
-        out_specs=P(),
+        in_specs=(P(axis, None), ids_spec),
+        out_specs=out_spec,
     )(table, ids)
 
 
@@ -94,7 +111,7 @@ def shard_table_in_scope(name: str, axis: str = "model",
     (distribute_transpiler.py:92)."""
     from ..core.scope import global_scope
     mesh = mesh or get_mesh()
-    scope = scope or global_scope()
+    scope = global_scope() if scope is None else scope
     val = scope.get(name)
     sharded = jax.device_put(val, NamedSharding(mesh, table_spec(axis)))
     scope.set(name, sharded)

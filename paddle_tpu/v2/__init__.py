@@ -1,0 +1,79 @@
+"""paddle.v2-compatible API facade (reference: python/paddle/v2/
+__init__.py — layer-object graphs + Topology + Parameters + SGD event
+trainer + infer, the OTHER of the two coexisting stacks).
+
+TPU-native stance (SURVEY §0): v2 is a capability surface, not a second
+engine. Every v2 layer lowers onto the same Program/XLA pipeline the
+fluid-style API uses; Parameters is a scope view; the trainer is the
+same jit-compiled Executor step behind the reference's event loop.
+"""
+from __future__ import annotations
+
+import os
+
+from . import activation  # noqa: F401
+from . import attr  # noqa: F401
+from . import config_base  # noqa: F401
+from . import data_type  # noqa: F401
+from . import evaluator  # noqa: F401
+from . import event  # noqa: F401
+from . import inference  # noqa: F401
+from . import layer  # noqa: F401
+from . import minibatch  # noqa: F401
+from . import networks  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import parameters  # noqa: F401
+from . import pooling  # noqa: F401
+from . import topology  # noqa: F401
+from . import trainer  # noqa: F401
+
+# data plumbing is shared with the modern API (one implementation)
+from .. import dataset  # noqa: F401
+from .. import reader  # noqa: F401
+from ..dataset import image  # noqa: F401
+from ..debug import Ploter  # noqa: F401
+
+
+class _PlotModule:
+    Ploter = Ploter
+
+
+plot = _PlotModule()
+
+
+class _MasterModule:
+    """v2.master.client (reference: python/paddle/v2/master/client.py
+    — ctypes client of the Go master). The TPU-native master service
+    lives in distributed/master.py; its client class is re-exported
+    here."""
+    try:
+        from ..distributed.master import MasterClient as client
+    except ImportError:  # pragma: no cover
+        client = None
+
+
+master = _MasterModule()
+
+infer = inference.infer
+batch = minibatch.batch
+
+
+def init(**kwargs) -> None:
+    """paddle.v2.init(use_gpu=..., trainer_count=...) (reference:
+    v2/__init__.py:127 — boots the legacy C++ runtime). The XLA runtime
+    needs no boot; PADDLE_INIT_* env vars keep their meaning for the
+    distributed contract (distributed/multihost.py reads them)."""
+    for ek, ev in os.environ.items():
+        if ek.startswith("PADDLE_INIT_"):
+            kwargs.setdefault(ek.replace("PADDLE_INIT_", "").lower(),
+                              ev)
+    # accepted-and-recorded; nothing to boot
+    init.last_args = dict(kwargs)
+
+
+__all__ = [
+    "optimizer", "layer", "activation", "parameters", "init",
+    "trainer", "event", "data_type", "attr", "pooling", "dataset",
+    "reader", "topology", "networks", "infer", "plot", "evaluator",
+    "image", "master", "batch",
+]

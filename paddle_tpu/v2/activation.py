@@ -1,0 +1,89 @@
+"""v2 activation objects (reference: python/paddle/v2/activation.py over
+trainer_config_helpers/activations.py). Each maps onto the fluid-style
+activation name the op library serves."""
+from __future__ import annotations
+
+
+class BaseActivation:
+    fluid_name: str = ""          # "" = identity
+
+    def __repr__(self):
+        return f"activation.{type(self).__name__}()"
+
+
+class Linear(BaseActivation):
+    fluid_name = ""
+
+
+Identity = Linear
+
+
+class Sigmoid(BaseActivation):
+    fluid_name = "sigmoid"
+
+
+class Tanh(BaseActivation):
+    fluid_name = "tanh"
+
+
+class Relu(BaseActivation):
+    fluid_name = "relu"
+
+
+class BRelu(BaseActivation):
+    fluid_name = "brelu"
+
+
+class SoftRelu(BaseActivation):
+    fluid_name = "soft_relu"
+
+
+class STanh(BaseActivation):
+    fluid_name = "stanh"
+
+
+class Softmax(BaseActivation):
+    fluid_name = "softmax"
+
+
+class SequenceSoftmax(BaseActivation):
+    fluid_name = "sequence_softmax"
+
+
+class Abs(BaseActivation):
+    fluid_name = "abs"
+
+
+class Square(BaseActivation):
+    fluid_name = "square"
+
+
+class Exp(BaseActivation):
+    fluid_name = "exp"
+
+
+class Log(BaseActivation):
+    fluid_name = "log"
+
+
+class SquareRoot(BaseActivation):
+    fluid_name = "sqrt"
+
+
+class Reciprocal(BaseActivation):
+    fluid_name = "reciprocal"
+
+
+def act_name(act) -> str:
+    """Activation object (or None) -> fluid act string ('' = none)."""
+    if act is None:
+        return ""
+    if isinstance(act, str):
+        return act
+    return act.fluid_name
+
+
+__all__ = ["BaseActivation", "Linear", "Identity", "Sigmoid", "Tanh",
+           "Relu", "BRelu", "SoftRelu", "STanh", "Softmax",
+           "SequenceSoftmax", "Abs", "Square", "Exp", "Log",
+           "SquareRoot", "Reciprocal"]

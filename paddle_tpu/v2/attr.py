@@ -1,0 +1,61 @@
+"""v2 attribute objects (reference: python/paddle/v2/attr.py re-exports
+ParameterAttribute/ExtraLayerAttribute). Param carries the fields v2
+scripts actually set; it converts to the framework ParamAttr."""
+from __future__ import annotations
+
+from ..param_attr import ParamAttr
+from ..initializer import NormalInitializer, UniformInitializer
+from ..regularizer import L2DecayRegularizer
+
+
+class ParameterAttribute:
+    def __init__(self, name=None, is_static=False, initial_std=None,
+                 initial_mean=None, initial_max=None, initial_min=None,
+                 l1_rate=None, l2_rate=None, learning_rate=1.0,
+                 momentum=None, gradient_clipping_threshold=None,
+                 sparse_update=False, initializer=None):
+        self.name = name
+        self.is_static = is_static
+        self.initial_std = initial_std
+        self.initial_mean = initial_mean
+        self.initial_max = initial_max
+        self.initial_min = initial_min
+        self.l2_rate = l2_rate
+        self.learning_rate = learning_rate
+        self.sparse_update = sparse_update
+        self.initializer = initializer
+
+    def to_param_attr(self) -> ParamAttr:
+        init = self.initializer
+        if init is None and (self.initial_std is not None
+                             or self.initial_mean is not None):
+            init = NormalInitializer(loc=self.initial_mean or 0.0,
+                                     scale=self.initial_std
+                                     if self.initial_std is not None
+                                     else 0.01)
+        elif init is None and (self.initial_max is not None
+                               or self.initial_min is not None):
+            init = UniformInitializer(low=self.initial_min or -1.0,
+                                      high=self.initial_max or 1.0)
+        reg = (L2DecayRegularizer(self.l2_rate)
+               if self.l2_rate else None)
+        return ParamAttr(name=self.name, initializer=init,
+                         learning_rate=self.learning_rate,
+                         regularizer=reg,
+                         trainable=not self.is_static)
+
+
+class ExtraLayerAttribute:
+    def __init__(self, error_clipping_threshold=None, drop_rate=None,
+                 device=None):
+        self.error_clipping_threshold = error_clipping_threshold
+        self.drop_rate = drop_rate
+        self.device = device
+
+
+Param = ParameterAttribute
+Extra = ExtraLayerAttribute
+Hook = object  # reference HookAttribute placeholder (pruning hooks)
+
+__all__ = ["Param", "Extra", "Hook", "ParameterAttribute",
+           "ExtraLayerAttribute"]

@@ -1,0 +1,63 @@
+"""v2 config-graph node (reference: python/paddle/v2/config_base.py —
+there a Layer wraps a trainer_config_helpers DSL call that emits
+ModelConfig protobuf; here a Layer is a lightweight DAG node that
+LOWERS onto the fluid-style Program builder (paddle_tpu.layers), so the
+legacy layer-object API and the modern program API share one engine —
+the SURVEY §0 stance that v2 is a capability surface, not a second
+stack)."""
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Dict, List, Optional
+
+_counters = itertools.count()
+
+
+class Layer:
+    """One node of the v2 layer graph.
+
+    name: user-visible layer name (auto-generated when omitted, in the
+    reference's `__{type}_{i}__` style so param names stay readable).
+    parents: input Layer nodes (the DAG edges).
+    build: fn(ctx) -> fluid var; ctx maps resolved parent vars by node.
+    """
+
+    def __init__(self, type_: str, parents: Optional[List["Layer"]] = None,
+                 name: Optional[str] = None,
+                 build: Optional[Callable] = None, size: int = 0):
+        self.type = type_
+        self.name = name or f"__{type_}_{next(_counters)}__"
+        self.parents = [p for p in (parents or []) if p is not None]
+        self._build = build
+        self.size = size
+
+    # -- graph walking -------------------------------------------------
+    def ancestors(self) -> List["Layer"]:
+        """All nodes reachable from self (self last), topologically
+        ordered, parents before children."""
+        seen: Dict[int, Layer] = {}
+        order: List[Layer] = []
+
+        def visit(node: "Layer"):
+            if id(node) in seen:
+                return
+            seen[id(node)] = node
+            for p in node.parents:
+                visit(p)
+            order.append(node)
+
+        visit(self)
+        return order
+
+    def to_var(self, ctx: Dict[int, object]):
+        """Resolve this node to a fluid var inside the active program
+        (memoized per-build in ctx)."""
+        if id(self) not in ctx:
+            if self._build is None:
+                raise NotImplementedError(
+                    f"v2 layer {self.type!r} has no lowering")
+            ctx[id(self)] = self._build(ctx)
+        return ctx[id(self)]
+
+    def __repr__(self):
+        return f"<v2.Layer {self.type} {self.name!r}>"

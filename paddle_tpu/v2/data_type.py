@@ -1,0 +1,76 @@
+"""v2 input types (reference: python/paddle/v2/data_type.py re-exports
+trainer/PyDataProvider2.py's InputType constructors). Each describes one
+data layer's per-sample value; the trainer's DataFeeder uses it to
+assemble batches (dense -> [b, dim] arrays, sequences -> ragged)."""
+from __future__ import annotations
+
+
+class SequenceType:
+    NO_SEQUENCE = 0
+    SEQUENCE = 1
+    SUB_SEQUENCE = 2
+
+
+class DataType:
+    Dense = 0
+    SparseNonValue = 1
+    SparseValue = 2
+    Index = 3
+
+
+class InputType:
+    def __init__(self, dim, seq_type, type_):
+        self.dim = dim
+        self.seq_type = seq_type
+        self.type = type_
+
+    def __repr__(self):
+        return (f"InputType(dim={self.dim}, seq={self.seq_type}, "
+                f"type={self.type})")
+
+
+def dense_vector(dim, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(dim, seq_type, DataType.Dense)
+
+
+def dense_vector_sequence(dim):
+    return dense_vector(dim, SequenceType.SEQUENCE)
+
+
+def dense_array(dim, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(dim, seq_type, DataType.Dense)
+
+
+def sparse_binary_vector(dim, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(dim, seq_type, DataType.SparseNonValue)
+
+
+def sparse_binary_vector_sequence(dim):
+    return sparse_binary_vector(dim, SequenceType.SEQUENCE)
+
+
+def sparse_vector(dim, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(dim, seq_type, DataType.SparseValue)
+
+
+def sparse_vector_sequence(dim):
+    return sparse_vector(dim, SequenceType.SEQUENCE)
+
+
+def integer_value(value_range, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(value_range, seq_type, DataType.Index)
+
+
+def integer_value_sequence(value_range):
+    return integer_value(value_range, SequenceType.SEQUENCE)
+
+
+def integer_value_sub_sequence(value_range):
+    return integer_value(value_range, SequenceType.SUB_SEQUENCE)
+
+
+__all__ = ["InputType", "SequenceType", "DataType", "dense_vector",
+           "dense_vector_sequence", "dense_array",
+           "sparse_binary_vector", "sparse_binary_vector_sequence",
+           "sparse_vector", "sparse_vector_sequence", "integer_value",
+           "integer_value_sequence", "integer_value_sub_sequence"]

@@ -1,0 +1,37 @@
+"""v2 evaluators (reference: python/paddle/v2/evaluator.py over the
+DSL's evaluator_base — attachable metric nodes). The facade exposes the
+two that v2 demos use as extra_layers; each returns a config node
+computing the metric in-graph."""
+from __future__ import annotations
+
+from .. import layers as F
+from .config_base import Layer
+
+
+def classification_error_evaluator(input, label, name=None, **_kw):
+    """1 - accuracy of an (already softmaxed) output vs int labels."""
+    node = Layer("classification_error_evaluator",
+                 parents=[input, label], name=name)
+
+    def build(ctx):
+        acc = F.accuracy(input=input.to_var(ctx),
+                         label=label.to_var(ctx))
+        return F.elementwise_sub(
+            F.fill_constant([1], "float32", 1.0), acc)
+
+    node._build = build
+    return node
+
+
+def auc_evaluator(input, label, name=None, **_kw):
+    node = Layer("auc_evaluator", parents=[input, label], name=name)
+
+    def build(ctx):
+        auc, _states = F.auc(input.to_var(ctx), label.to_var(ctx))
+        return auc
+
+    node._build = build
+    return node
+
+
+__all__ = ["classification_error_evaluator", "auc_evaluator"]

@@ -1,0 +1,19 @@
+"""v2 training events (reference: python/paddle/v2/event.py). The
+names and fields v2 event handlers switch on."""
+from __future__ import annotations
+
+from ..trainer import (BeginIteration, BeginPass, EndIteration,  # noqa: F401
+                       EndPass)
+
+
+class TestResult:
+    """Result of trainer.test() (reference event.py TestResult)."""
+
+    def __init__(self, evaluator=None, cost=None, metrics=None):
+        self.evaluator = evaluator
+        self.cost = cost
+        self.metrics = metrics or {}
+
+
+__all__ = ["BeginPass", "EndPass", "BeginIteration", "EndIteration",
+           "TestResult"]

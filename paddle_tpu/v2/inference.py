@@ -1,0 +1,79 @@
+"""v2 inference (reference: python/paddle/v2/inference.py — Inference
+wraps a forward-only GradientMachine over a Topology + Parameters;
+infer() feeds batches and concatenates outputs)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .parameters import Parameters
+from .topology import Topology
+
+
+class Inference:
+    def __init__(self, output_layer, parameters: Parameters):
+        import paddle_tpu as pt
+        self._topology = Topology(output_layer)
+        self._parameters = parameters
+        # inference mode: BN moving stats, dropout identity
+        self._main, startup, self._fetches = \
+            self._topology.programs(is_test=True)
+        # materialize any non-parameter persistables (e.g. BN stats)
+        # the forward graph needs but the tar didn't carry
+        from ..core.scope import Scope
+        tmp = Scope()
+        pt.Executor().run(startup, scope=tmp)
+        for name in list(tmp.local_names()):
+            if not parameters.scope.has(name):
+                parameters.scope.set(name, tmp.get(name))
+        self._exe = pt.Executor()
+
+    def _feeder(self, feeding: Optional[dict]):
+        from ..data_feeder import DataFeeder
+        data_layers = self._topology.data_layers()
+        if feeding:
+            by_index = sorted(
+                (idx, name) for name, idx in feeding.items())
+            order = {d.name: d for d in data_layers}
+            data_layers = [order[n] for _i, n in by_index
+                           if n in order]
+        block = self._main.global_block()
+        return DataFeeder([block.var(d.name) for d in data_layers])
+
+    def infer(self, input, feeding=None) -> np.ndarray:
+        feeder = self._feeder(feeding)
+        outs = []
+        fetch_vars = [self._fetches[o.name]
+                      for o in self._topology.outputs]
+        for batch in _batches(input):
+            res = self._exe.run(self._main, feed=feeder.feed(batch),
+                                fetch_list=fetch_vars,
+                                scope=self._parameters.scope)
+            outs.append([np.asarray(r) for r in res])
+        if len(fetch_vars) == 1:
+                return np.concatenate([o[0] for o in outs], axis=0)
+        # multiple output layers: tuple of concatenated arrays
+        return tuple(np.concatenate([o[i] for o in outs], axis=0)
+                     for i in range(len(fetch_vars)))
+
+
+def _batches(input):
+    """v2 infer() takes the WHOLE input as a list of samples; run it as
+    one batch (callers wanting batching pass an iterable of lists)."""
+    if callable(input):
+        yield from input()
+    elif input and isinstance(input[0], (list, tuple)) and input[0] and \
+            isinstance(input[0][0], (list, tuple, np.ndarray, float, int)):
+        yield input
+    else:
+        yield input
+
+
+def infer(output_layer, parameters, input, feeding=None, field="value"):
+    if field != "value":
+        raise NotImplementedError(
+            "field='value' is the supported v2 infer field (ids come "
+            "from max_id layers)")
+    return Inference(output_layer, parameters).infer(input,
+                                                     feeding=feeding)

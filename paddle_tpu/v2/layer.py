@@ -1,0 +1,539 @@
+"""v2 layer-object API (reference: python/paddle/v2/layer.py, which
+re-exports the trainer_config_helpers DSL as graph-building functions
+returning config_base.Layer nodes; Topology walks them and a C++
+GradientMachine executes the emitted ModelConfig).
+
+TPU-native realization: each function returns a config_base.Layer whose
+`build` lowers onto the fluid-style Program builder (paddle_tpu.layers)
+— one op library and one XLA execution engine serve both API
+generations (SURVEY §0; the 103-type vocabulary parity is audited by
+tests/test_v2_layer_surface.py, and this module makes the most-used
+subset RUNNABLE as real v2 layer objects)."""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import numpy as np
+
+from .. import layers as F
+from ..layer_helper import ParamAttr
+from .activation import act_name
+from .attr import ParameterAttribute
+from .config_base import Layer
+from .data_type import DataType, InputType, SequenceType
+from . import pooling as _pooling
+
+
+class AggregateLevel:
+    TO_NO_SEQUENCE = "word"
+    TO_SEQUENCE = "sequence"
+    # legacy aliases
+    EACH_TIMESTEP = TO_NO_SEQUENCE
+    EACH_SEQUENCE = TO_SEQUENCE
+
+
+class ExpandLevel:
+    FROM_NO_SEQUENCE = AggregateLevel.TO_NO_SEQUENCE
+    FROM_SEQUENCE = AggregateLevel.TO_SEQUENCE
+
+
+def _listify(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _pattr(attr, default_name):
+    """v2 attr.Param / ParamAttr / None -> framework ParamAttr with a
+    stable reference-style param name ('{layer}.w0' etc.)."""
+    if attr is False:
+        return False
+    if attr is None:
+        return ParamAttr(name=default_name)
+    if isinstance(attr, ParameterAttribute):
+        pa = attr.to_param_attr()
+    elif isinstance(attr, ParamAttr):
+        pa = attr
+    else:
+        raise TypeError(f"bad param attr {attr!r}")
+    if pa.name is None:
+        pa.name = default_name
+    return pa
+
+
+def _apply_act(var, act):
+    name = act_name(act)
+    if not name:
+        return var
+    fn = getattr(F, name, None)
+    if fn is None:
+        raise NotImplementedError(f"activation {name!r}")
+    return fn(var)
+
+
+def _image_of(node: Layer, var, num_channels: Optional[int]):
+    """Resolve a [b, C, H, W] view of `var`: either it is already 4-D,
+    or the producing node carries an img_shape, or (C given) H=W is
+    inferred from the flat dim — the reference config parser's rule for
+    dense image inputs."""
+    shape = getattr(node, "img_shape", None)
+    if len(var.shape) == 4:
+        return var, tuple(var.shape[1:])
+    if shape is None:
+        if not num_channels:
+            raise ValueError(
+                f"layer {node.name}: num_channels required to interpret "
+                f"a flat input of dim {var.shape[-1]} as an image")
+        hw = int(math.isqrt(int(var.shape[-1]) // num_channels))
+        shape = (num_channels, hw, hw)
+    c, h, w = shape
+    return F.reshape(var, [-1, c, h, w]), (c, h, w)
+
+
+# ---------------------------------------------------------------------
+# data
+# ---------------------------------------------------------------------
+
+def data(name: str, type: InputType, height=None, width=None, **_kw):
+    node = Layer("data", name=name, size=type.dim)
+    node.data_type = type
+    if height and width:
+        node.img_shape = (type.dim // (height * width), height, width)
+
+    def build(ctx):
+        if type.type == DataType.Dense:
+            shape, dtype = [type.dim], "float32"
+        elif type.type == DataType.Index:
+            shape, dtype = [1], "int64"
+        else:
+            raise NotImplementedError(
+                "sparse v2 inputs: feed the dense multi-hot form "
+                "(the TPU path has no host-side sparse format)")
+        lod = {SequenceType.NO_SEQUENCE: 0, SequenceType.SEQUENCE: 1,
+               SequenceType.SUB_SEQUENCE: 2}[type.seq_type]
+        return F.data(name, shape, dtype=dtype, lod_level=lod)
+
+    node._build = build
+    return node
+
+
+# ---------------------------------------------------------------------
+# core layers
+# ---------------------------------------------------------------------
+
+def fc(input, size, act=None, name=None, param_attr=None,
+       bias_attr=None, layer_attr=None):
+    inputs = _listify(input)
+    node = Layer("fc", parents=inputs, name=name, size=size)
+
+    def build(ctx):
+        attrs = param_attr if isinstance(param_attr, (list, tuple)) \
+            else [param_attr] * len(inputs)
+        parts = []
+        for i, (inp, pa) in enumerate(zip(inputs, attrs)):
+            parts.append(F.fc(
+                inp.to_var(ctx), size=size,
+                param_attr=_pattr(pa, f"{node.name}.w{i}"),
+                bias_attr=False))
+        out = parts[0] if len(parts) == 1 else F.sums(parts)
+        if bias_attr is not False:
+            b = F.create_parameter(
+                [size], "float32",
+                name=(bias_attr.name if isinstance(
+                    bias_attr, ParameterAttribute) and bias_attr.name
+                    else f"{node.name}.wbias"),
+                default_initializer=None, is_bias=True)
+            out = F.elementwise_add(out, b)
+        return _apply_act(out, act)
+
+    node._build = build
+    return node
+
+
+def embedding(input, size, param_attr=None, name=None, **_kw):
+    (inp,) = _listify(input)
+    node = Layer("embedding", parents=[inp], name=name, size=size)
+
+    def build(ctx):
+        vocab = inp.data_type.dim if hasattr(inp, "data_type") else None
+        if vocab is None:
+            raise ValueError("v2 embedding needs a data() parent with "
+                             "an integer_value type")
+        return F.embedding(
+            inp.to_var(ctx), size=[vocab, size],
+            param_attr=_pattr(param_attr, f"{node.name}.w0"))
+
+    node._build = build
+    return node
+
+
+def img_conv(input, filter_size, num_filters, num_channels=None,
+             stride=1, padding=0, act=None, name=None, param_attr=None,
+             bias_attr=None, groups=1, filter_size_y=None,
+             stride_y=None, padding_y=None, trans=False, **_kw):
+    (inp,) = _listify(input)
+    node = Layer("img_conv", parents=[inp], name=name, size=num_filters)
+
+    def build(ctx):
+        var, (c, h, w) = _image_of(inp, inp.to_var(ctx), num_channels)
+        fs = (filter_size, filter_size_y or filter_size)
+        st = (stride, stride_y or stride)
+        pd = (padding, padding_y if padding_y is not None else padding)
+        if trans:
+            out = F.conv2d_transpose(
+                var, num_filters=num_filters, filter_size=fs,
+                stride=st, padding=pd,
+                act=act_name(act) or None,
+                param_attr=_pattr(param_attr, f"{node.name}.w0"),
+                bias_attr=(False if bias_attr is False else _pattr(
+                    bias_attr, f"{node.name}.wbias")))
+            oh = (h - 1) * st[0] - 2 * pd[0] + fs[0]
+            ow = (w - 1) * st[1] - 2 * pd[1] + fs[1]
+        else:
+            out = F.conv2d(
+                var, num_filters=num_filters, filter_size=fs,
+                stride=st, padding=pd, groups=groups,
+                act=act_name(act) or None,
+                param_attr=_pattr(param_attr, f"{node.name}.w0"),
+                bias_attr=(False if bias_attr is False else _pattr(
+                    bias_attr, f"{node.name}.wbias")))
+            oh = (h + 2 * pd[0] - fs[0]) // st[0] + 1
+            ow = (w + 2 * pd[1] - fs[1]) // st[1] + 1
+        node.img_shape = (num_filters, oh, ow)
+        return out
+
+    node._build = build
+    return node
+
+
+def img_pool(input, pool_size, num_channels=None, pool_type=None,
+             stride=1, padding=0, name=None, pool_size_y=None,
+             stride_y=None, padding_y=None, **_kw):
+    (inp,) = _listify(input)
+    node = Layer("img_pool", parents=[inp], name=name)
+    ptype = (pool_type or _pooling.Max()).fluid_name
+
+    def build(ctx):
+        var, (c, h, w) = _image_of(inp, inp.to_var(ctx), num_channels)
+        ks = (pool_size, pool_size_y or pool_size)
+        st = (stride, stride_y or stride)
+        pd = (padding, padding_y if padding_y is not None else padding)
+        out = F.pool2d(var, pool_size=ks, pool_type=ptype,
+                       pool_stride=st, pool_padding=pd)
+        oh = (h + 2 * pd[0] - ks[0]) // st[0] + 1
+        ow = (w + 2 * pd[1] - ks[1]) // st[1] + 1
+        node.img_shape = (c, oh, ow)
+        return out
+
+    node._build = build
+    return node
+
+
+def batch_norm(input, act=None, num_channels=None, name=None,
+               param_attr=None, bias_attr=None, use_global_stats=None,
+               moving_average_fraction=0.9, **_kw):
+    (inp,) = _listify(input)
+    node = Layer("batch_norm", parents=[inp], name=name)
+
+    def build(ctx):
+        var = inp.to_var(ctx)
+        if len(var.shape) == 2 and getattr(inp, "img_shape", None):
+            var, shape = _image_of(inp, var, num_channels)
+            node.img_shape = shape
+        return F.batch_norm(
+            var, act=act_name(act) or None,
+            is_test=bool(use_global_stats),
+            momentum=moving_average_fraction,
+            param_attr=_pattr(param_attr, f"{node.name}.w0"),
+            bias_attr=_pattr(bias_attr, f"{node.name}.wbias"))
+
+    node._build = build
+    return node
+
+
+def img_cmrnorm(input, size, scale=0.0128, power=0.75, name=None,
+                num_channels=None, **_kw):
+    """Cross-map response normalization -> lrn (reference
+    CMRProjectionNormLayer; alpha = scale/size per the legacy config
+    parser's convention)."""
+    (inp,) = _listify(input)
+    node = Layer("img_cmrnorm", parents=[inp], name=name)
+
+    def build(ctx):
+        var, shape = _image_of(inp, inp.to_var(ctx), num_channels)
+        node.img_shape = shape
+        return F.lrn(var, n=size, alpha=scale, beta=power)
+
+    node._build = build
+    return node
+
+
+def sum_to_one_norm(input, name=None):
+    (inp,) = _listify(input)
+    node = Layer("sum_to_one_norm", parents=[inp], name=name)
+
+    def build(ctx):
+        var = inp.to_var(ctx)
+        denom = F.reduce_sum(var, dim=-1, keep_dim=True)
+        return F.elementwise_div(var, denom)
+
+    node._build = build
+    return node
+
+
+def maxout(input, groups, num_channels=None, name=None, **_kw):
+    (inp,) = _listify(input)
+    node = Layer("maxout", parents=[inp], name=name)
+
+    def build(ctx):
+        var, (c, h, w) = _image_of(inp, inp.to_var(ctx), num_channels)
+        node.img_shape = (c // groups, h, w)
+        return F.maxout(var, groups=groups)
+
+    node._build = build
+    return node
+
+
+def spp(input, pyramid_height, num_channels=None, pool_type=None,
+        name=None, **_kw):
+    """Spatial pyramid pooling: pool at 1x1..2^k x 2^k grids, flatten,
+    concat (reference SpatialPyramidPoolLayer)."""
+    (inp,) = _listify(input)
+    node = Layer("spp", parents=[inp], name=name)
+    ptype = (pool_type or _pooling.Max()).fluid_name
+
+    def build(ctx):
+        var, (c, h, w) = _image_of(inp, inp.to_var(ctx), num_channels)
+        outs = []
+        for lvl in range(pyramid_height):
+            bins = 2 ** lvl
+            ks = (math.ceil(h / bins), math.ceil(w / bins))
+            st = (math.ceil(h / bins), math.ceil(w / bins))
+            p = F.pool2d(var, pool_size=ks, pool_type=ptype,
+                         pool_stride=st)
+            outs.append(F.reshape(p, [-1, c * bins * bins]))
+        return F.concat(outs, axis=1)
+
+    node._build = build
+    return node
+
+
+def dropout(input, dropout_rate, name=None):
+    (inp,) = _listify(input)
+    node = Layer("dropout", parents=[inp], name=name)
+    node._build = lambda ctx: F.dropout(inp.to_var(ctx),
+                                        dropout_prob=dropout_rate)
+    return node
+
+
+def addto(input, act=None, name=None, bias_attr=None, **_kw):
+    inputs = _listify(input)
+    node = Layer("addto", parents=inputs, name=name)
+
+    def build(ctx):
+        out = F.sums([i.to_var(ctx) for i in inputs])
+        return _apply_act(out, act)
+
+    node._build = build
+    return node
+
+
+def concat(input, act=None, name=None, **_kw):
+    inputs = _listify(input)
+    node = Layer("concat", parents=inputs, name=name)
+
+    def build(ctx):
+        out = F.concat([i.to_var(ctx) for i in inputs], axis=-1)
+        return _apply_act(out, act)
+
+    node._build = build
+    return node
+
+
+def cos_sim(a, b, scale=1, name=None, **_kw):
+    node = Layer("cos_sim", parents=[a, b], name=name)
+    node._build = lambda ctx: F.scale(
+        F.cos_sim(a.to_var(ctx), b.to_var(ctx)), scale=float(scale))
+    return node
+
+
+def conv_shift(a, b, name=None):
+    """Circular 1-D correlation (reference ConvShiftLayer /
+    conv_shift_op.cc): out[i] = sum_j a[i+j-floor(n/2)] * b[j]."""
+    node = Layer("conv_shift", parents=[a, b], name=name)
+
+    def build(ctx):
+        from ..layer_helper import LayerHelper
+        av, bv = a.to_var(ctx), b.to_var(ctx)
+        helper = LayerHelper("conv_shift")
+        out = helper.create_tmp_variable("float32")
+        helper.append_op(type="conv_shift",
+                         inputs={"X": av, "Y": bv},
+                         outputs={"Out": out})
+        return out
+
+    node._build = build
+    return node
+
+
+def max_id(input, name=None):
+    (inp,) = _listify(input)
+    node = Layer("max_id", parents=[inp], name=name)
+    node._build = lambda ctx: F.argmax(inp.to_var(ctx), axis=-1)
+    return node
+
+
+# ---------------------------------------------------------------------
+# sequence layers
+# ---------------------------------------------------------------------
+
+def pooling(input, pooling_type=None, agg_level=None, name=None, **_kw):
+    (inp,) = _listify(input)
+    node = Layer("pooling", parents=[inp], name=name)
+    ptype = (pooling_type or _pooling.Max()).fluid_name
+
+    node._build = lambda ctx: F.sequence_pool(inp.to_var(ctx),
+                                              pool_type=ptype)
+    return node
+
+
+def last_seq(input, agg_level=None, name=None, **_kw):
+    (inp,) = _listify(input)
+    node = Layer("last_seq", parents=[inp], name=name)
+    node._build = lambda ctx: F.sequence_last_step(inp.to_var(ctx))
+    return node
+
+
+def first_seq(input, agg_level=None, name=None, **_kw):
+    (inp,) = _listify(input)
+    node = Layer("first_seq", parents=[inp], name=name)
+    node._build = lambda ctx: F.sequence_first_step(inp.to_var(ctx))
+    return node
+
+
+def lstmemory(input, name=None, reverse=False, act=None,
+              gate_act=None, state_act=None, param_attr=None,
+              bias_attr=None, **_kw):
+    """LSTM over a sequence of 4h-dim gate pre-activations, like the
+    reference LstmLayer (the projection lives in a preceding fc — see
+    networks.simple_lstm)."""
+    (inp,) = _listify(input)
+    node = Layer("lstmemory", parents=[inp], name=name)
+
+    def build(ctx):
+        var = inp.to_var(ctx)
+        size = int(var.shape[-1])
+        hidden, _cell = F.dynamic_lstm(
+            var, size=size, is_reverse=reverse,
+            gate_activation=act_name(gate_act) or "sigmoid",
+            cell_activation=act_name(state_act) or "tanh",
+            candidate_activation=act_name(act) or "tanh",
+            param_attr=_pattr(param_attr, f"{node.name}.w0"),
+            bias_attr=_pattr(bias_attr, f"{node.name}.wbias"))
+        return hidden
+
+    node._build = build
+    return node
+
+
+def gru(input, size=None, name=None, reverse=False, act=None,
+        gate_act=None, param_attr=None, bias_attr=None, **_kw):
+    (inp,) = _listify(input)
+    node = Layer("gru", parents=[inp], name=name)
+
+    def build(ctx):
+        var = inp.to_var(ctx)
+        sz = size or int(var.shape[-1]) // 3
+        return F.dynamic_gru(
+            var, size=sz, is_reverse=reverse,
+            candidate_activation=act_name(act) or "tanh",
+            gate_activation=act_name(gate_act) or "sigmoid",
+            param_attr=_pattr(param_attr, f"{node.name}.w0"),
+            bias_attr=_pattr(bias_attr, f"{node.name}.wbias"))
+
+    node._build = build
+    return node
+
+
+grumemory = gru
+
+
+def expand(input, expand_as, expand_level=None, name=None, **_kw):
+    node = Layer("expand", parents=[input, expand_as], name=name)
+    node._build = lambda ctx: F.sequence_expand(
+        input.to_var(ctx), expand_as.to_var(ctx))
+    return node
+
+
+# ---------------------------------------------------------------------
+# costs
+# ---------------------------------------------------------------------
+
+def classification_cost(input, label, weight=None, name=None, **_kw):
+    """Cross-entropy on an already-softmaxed input (v2 convention: the
+    output layer carries act=Softmax())."""
+    parents = [input, label] + _listify(weight)
+    node = Layer("classification_cost", parents=parents, name=name)
+
+    def build(ctx):
+        ce = F.cross_entropy(input.to_var(ctx), label.to_var(ctx))
+        if weight is not None:
+            ce = F.elementwise_mul(ce, weight.to_var(ctx))
+        return F.mean(ce)
+
+    node._build = build
+    return node
+
+
+def cross_entropy_cost(input, label, name=None, **_kw):
+    return classification_cost(input, label, name=name)
+
+
+def square_error_cost(input, label, name=None, **_kw):
+    node = Layer("square_error_cost", parents=[input, label], name=name)
+    node._build = lambda ctx: F.mean(F.square_error_cost(
+        input.to_var(ctx), label.to_var(ctx)))
+    return node
+
+
+mse_cost = square_error_cost
+regression_cost = square_error_cost
+
+
+# ---------------------------------------------------------------------
+# parse_network — the reference returns the emitted ModelConfig proto;
+# here the equivalent artifact is a summary of the lowered Program.
+# ---------------------------------------------------------------------
+
+def parse_network(*outputs):
+    """Lower the graphs reachable from `outputs` into a throwaway
+    Program and return a ModelConfig-shaped summary dict (layers,
+    parameters, input/output layer names)."""
+    import paddle_tpu as pt
+    from .topology import Topology
+
+    outs = []
+    for o in outputs:
+        outs.extend(_listify(o))
+    topo = Topology(outs)
+    main, _startup, _fetches = topo.programs()
+    return {
+        "layers": [{"name": n.name, "type": n.type}
+                   for n in topo.nodes()],
+        "parameters": [{"name": p.name, "shape": list(p.shape)}
+                       for p in main.all_parameters()],
+        "input_layer_names": [d.name for d in topo.data_layers()],
+        "output_layer_names": [o.name for o in outs],
+    }
+
+
+__all__ = [
+    "AggregateLevel", "ExpandLevel", "data", "fc", "embedding",
+    "img_conv", "img_pool", "batch_norm", "img_cmrnorm",
+    "sum_to_one_norm", "maxout", "spp", "dropout", "addto", "concat",
+    "cos_sim", "conv_shift", "max_id", "pooling", "last_seq",
+    "first_seq", "lstmemory", "gru", "grumemory", "expand",
+    "classification_cost", "cross_entropy_cost", "square_error_cost",
+    "mse_cost", "regression_cost", "parse_network",
+]

@@ -1,0 +1,6 @@
+"""v2 minibatch (reference: python/paddle/v2/minibatch.py:18)."""
+from __future__ import annotations
+
+from ..reader import batch  # noqa: F401  (same semantics, one impl)
+
+__all__ = ["batch"]

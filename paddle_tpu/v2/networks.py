@@ -1,0 +1,103 @@
+"""v2 composite networks (reference:
+python/paddle/v2/networks.py over trainer_config_helpers/networks.py —
+the handful of compositions v2 demos actually use)."""
+from __future__ import annotations
+
+from . import layer
+from . import pooling as _pooling
+from .activation import Relu, Sigmoid, Tanh
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride, act=None, num_channel=None,
+                         num_channels=None, padding=0, name=None,
+                         pool_type=None, **_kw):
+    conv = layer.img_conv(input=input, filter_size=filter_size,
+                          num_filters=num_filters,
+                          num_channels=num_channels or num_channel,
+                          padding=padding, act=act or Relu(),
+                          name=name and f"{name}_conv")
+    return layer.img_pool(input=conv, pool_size=pool_size,
+                          stride=pool_stride,
+                          pool_type=pool_type or _pooling.Max(),
+                          name=name and f"{name}_pool")
+
+
+def img_conv_group(input, conv_num_filter, conv_filter_size=3,
+                   pool_size=2, pool_stride=2, conv_act=None,
+                   conv_padding=1, conv_batchnorm=False,
+                   num_channels=None, pool_type=None, **_kw):
+    tmp = input
+    channels = num_channels
+    for nf in conv_num_filter:
+        tmp = layer.img_conv(input=tmp, filter_size=conv_filter_size,
+                             num_filters=nf, num_channels=channels,
+                             padding=conv_padding,
+                             act=None if conv_batchnorm
+                             else (conv_act or Relu()))
+        if conv_batchnorm:
+            tmp = layer.batch_norm(input=tmp,
+                                   act=conv_act or Relu())
+        channels = None
+    return layer.img_pool(input=tmp, pool_size=pool_size,
+                          stride=pool_stride,
+                          pool_type=pool_type or _pooling.Max())
+
+
+def simple_lstm(input, size, reverse=False, act=None, gate_act=None,
+                state_act=None, name=None, **_kw):
+    """fc(4*size) + lstmemory — the reference simple_lstm pairing."""
+    proj = layer.fc(input=input, size=size * 4, bias_attr=False,
+                    name=name and f"{name}_proj")
+    return layer.lstmemory(input=proj, reverse=reverse, act=act,
+                           gate_act=gate_act, state_act=state_act,
+                           name=name)
+
+
+def bidirectional_lstm(input, size, return_seq=True, name=None, **_kw):
+    fwd = simple_lstm(input, size, reverse=False,
+                      name=name and f"{name}_fw")
+    bwd = simple_lstm(input, size, reverse=True,
+                      name=name and f"{name}_bw")
+    if return_seq:
+        return layer.concat(input=[fwd, bwd])
+    return layer.concat(input=[layer.last_seq(fwd),
+                               layer.first_seq(bwd)])
+
+
+def simple_gru(input, size, reverse=False, act=None, gate_act=None,
+               name=None, **_kw):
+    proj = layer.fc(input=input, size=size * 3, bias_attr=False,
+                    name=name and f"{name}_proj")
+    return layer.gru(input=proj, size=size, reverse=reverse, act=act,
+                     gate_act=gate_act, name=name)
+
+
+def sequence_conv_pool(input, context_len, hidden_size, act=None,
+                       pool_type=None, name=None, **_kw):
+    """Context-window sequence convolution + sequence pooling
+    (reference text conv: context_projection + fc + pooling; lowered
+    onto layers.sequence_conv, which slides a context_len window over
+    the ragged sequence)."""
+    from .. import layers as F
+    from .activation import act_name
+    from .config_base import Layer as _Node
+
+    (inp,) = [input] if not isinstance(input, (list, tuple)) else input
+    conv = _Node("sequence_conv", parents=[inp],
+                 name=name and f"{name}_conv")
+
+    def build(ctx):
+        return F.sequence_conv(inp.to_var(ctx),
+                               num_filters=hidden_size,
+                               filter_size=context_len,
+                               act=act_name(act or Tanh()) or None)
+
+    conv._build = build
+    return layer.pooling(input=conv,
+                         pooling_type=pool_type or _pooling.Max(),
+                         name=name)
+
+
+__all__ = ["simple_img_conv_pool", "img_conv_group", "simple_lstm",
+           "bidirectional_lstm", "simple_gru", "sequence_conv_pool"]

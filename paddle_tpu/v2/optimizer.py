@@ -1,0 +1,97 @@
+"""v2 optimizers (reference: python/paddle/v2/optimizer.py — wrappers
+that carry the update rule + regularization/model-average settings into
+the trainer). Each wraps the corresponding paddle_tpu.optimizer."""
+from __future__ import annotations
+
+from .. import optimizer as _fluid_opt
+
+
+class Optimizer:
+    def __init__(self, learning_rate=1e-3, regularization=None,
+                 model_average=None, gradient_clipping_threshold=None,
+                 learning_rate_decay_a=None, learning_rate_decay_b=None,
+                 learning_rate_schedule=None, **_kw):
+        self.learning_rate = learning_rate
+        self.regularization = regularization
+        self.model_average = model_average
+
+    def to_fluid(self):
+        raise NotImplementedError
+
+    def _kwargs(self):
+        kw = {"learning_rate": self.learning_rate}
+        if self.regularization is not None:
+            kw["regularization"] = self.regularization
+        return kw
+
+
+class Momentum(Optimizer):
+    def __init__(self, momentum=0.9, sparse=False, **kw):
+        super().__init__(**kw)
+        self.momentum = momentum
+
+    def to_fluid(self):
+        return _fluid_opt.MomentumOptimizer(momentum=self.momentum,
+                                            **self._kwargs())
+
+
+class Adam(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+        super().__init__(**kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def to_fluid(self):
+        return _fluid_opt.AdamOptimizer(beta1=self.beta1,
+                                        beta2=self.beta2,
+                                        epsilon=self.epsilon,
+                                        **self._kwargs())
+
+
+class Adamax(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, **kw):
+        super().__init__(**kw)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def to_fluid(self):
+        return _fluid_opt.AdamaxOptimizer(beta1=self.beta1,
+                                          beta2=self.beta2,
+                                          **self._kwargs())
+
+
+class AdaGrad(Optimizer):
+    def to_fluid(self):
+        return _fluid_opt.AdagradOptimizer(**self._kwargs())
+
+
+class DecayedAdaGrad(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kw):
+        super().__init__(**kw)
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_fluid(self):
+        return _fluid_opt.DecayedAdagradOptimizer(
+            decay=self.rho, epsilon=self.epsilon, **self._kwargs())
+
+
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kw):
+        super().__init__(**kw)
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_fluid(self):
+        return _fluid_opt.AdadeltaOptimizer(
+            rho=self.rho, epsilon=self.epsilon, **self._kwargs())
+
+
+class RMSProp(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kw):
+        super().__init__(**kw)
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_fluid(self):
+        return _fluid_opt.RMSPropOptimizer(
+            rho=self.rho, epsilon=self.epsilon, **self._kwargs())
+
+
+__all__ = ["Optimizer", "Momentum", "Adam", "Adamax", "AdaGrad",
+           "DecayedAdaGrad", "AdaDelta", "RMSProp"]

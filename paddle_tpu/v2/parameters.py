@@ -1,0 +1,124 @@
+"""v2 Parameters (reference: python/paddle/v2/parameters.py:44 — a
+dict-like view of the GradientMachine's parameter blocks with numpy
+get/set and tar (de)serialization).
+
+TPU-native: Parameters owns a private Scope holding the initialized
+jax arrays; the trainer and inference run programs against that scope,
+so numpy reads/writes here are reads/writes of the live training
+state. to_tar/from_tar keep the reference's "parameters travel as one
+archive" capability (numpy .npy members inside a tar)."""
+from __future__ import annotations
+
+import io as _io
+import tarfile
+from typing import Dict, List
+
+import numpy as np
+
+
+def create(layers):
+    """parameters.create(cost_or_output_layers) (reference
+    parameters.py:27)."""
+    from .topology import Topology
+    topo = layers if isinstance(layers, Topology) else Topology(layers)
+    return Parameters(topo)
+
+
+class Parameters:
+    def __init__(self, topology=None):
+        from ..core.scope import Scope
+        self._scope = Scope()
+        self._shapes: Dict[str, tuple] = {}
+        if topology is not None:
+            import paddle_tpu as pt
+            main, startup, _ = topology.programs()
+            pt.Executor().run(startup, scope=self._scope)
+            for p in main.all_parameters():
+                self._shapes[p.name] = tuple(p.shape)
+
+    # -- dict-like surface (reference parameters.py:108-271) ----------
+    def keys(self) -> List[str]:
+        return list(self._shapes)
+
+    def names(self) -> List[str]:
+        return self.keys()
+
+    def has_key(self, key) -> bool:
+        return key in self._shapes
+
+    def __contains__(self, key) -> bool:
+        return key in self._shapes
+
+    def __iter__(self):
+        return iter(self.keys())
+
+    def __len__(self):
+        return len(self._shapes)
+
+    def __getitem__(self, key) -> np.ndarray:
+        if key not in self._shapes:
+            raise ValueError(f"no parameter {key!r}")
+        return np.asarray(self._scope.get(key))
+
+    def get(self, key) -> np.ndarray:
+        return self[key]
+
+    def get_shape(self, key):
+        if key not in self._shapes:
+            raise ValueError(f"no parameter {key!r}")
+        return self._shapes[key]
+
+    def __setitem__(self, key, value) -> None:
+        value = np.asarray(value, dtype=np.float32)
+        shape = self._shapes.get(key)
+        if shape is not None and tuple(value.shape) != tuple(shape):
+            raise ValueError(
+                f"shape mismatch for {key!r}: expected {shape}, got "
+                f"{value.shape}")
+        self._shapes.setdefault(key, tuple(value.shape))
+        self._scope.set(key, value)
+
+    def set(self, key, value) -> None:
+        self[key] = value
+
+    # -- serialization (reference to_tar/from_tar, parameters.py:328) --
+    def to_tar(self, f) -> None:
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            for name in self.keys():
+                buf = _io.BytesIO()
+                np.save(buf, self[name], allow_pickle=False)
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name=name + ".npy")
+                info.size = len(data)
+                tar.addfile(info, _io.BytesIO(data))
+
+    @staticmethod
+    def from_tar(f) -> "Parameters":
+        params = Parameters()
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            for member in tar.getmembers():
+                if not member.name.endswith(".npy"):
+                    continue
+                arr = np.load(
+                    _io.BytesIO(tar.extractfile(member).read()),
+                    allow_pickle=False)
+                params[member.name[:-4]] = arr
+        return params
+
+    def init_from_tar(self, f, exclude_params=()) -> None:
+        other = Parameters.from_tar(f)
+        for name in other.keys():
+            if name in exclude_params:
+                continue
+            self[name] = other[name]
+
+    # -- trainer integration ------------------------------------------
+    @property
+    def scope(self):
+        return self._scope
+
+    def adopt(self, main_program) -> None:
+        """Record any parameters of `main_program` not yet tracked
+        (e.g. when the trainer lowers a wider graph than create saw)."""
+        for p in main_program.all_parameters():
+            self._shapes.setdefault(p.name, tuple(p.shape))

@@ -1,0 +1,38 @@
+"""v2 pooling objects (reference: python/paddle/v2/pooling.py over
+trainer_config_helpers/poolings.py)."""
+from __future__ import annotations
+
+
+class BasePool:
+    fluid_name = "max"
+
+    def __repr__(self):
+        return f"pooling.{type(self).__name__}()"
+
+
+class Max(BasePool):
+    fluid_name = "max"
+
+
+class CudnnMax(Max):
+    pass
+
+
+class Avg(BasePool):
+    fluid_name = "avg"
+
+
+class CudnnAvg(Avg):
+    pass
+
+
+class Sum(BasePool):
+    fluid_name = "sum"
+
+
+class SquareRootN(BasePool):
+    fluid_name = "sqrt"
+
+
+__all__ = ["Max", "CudnnMax", "Avg", "CudnnAvg", "Sum", "SquareRootN",
+           "BasePool"]

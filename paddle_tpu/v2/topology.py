@@ -1,0 +1,106 @@
+"""v2 Topology (reference: python/paddle/v2/topology.py:27 — wraps the
+ModelConfig proto parsed from the layer graph; data_layers()/data_type()
+drive feeding and serialize_for_inference feeds the C inference path).
+
+TPU-native: the topology owns the LOWERING of the v2 layer DAG onto
+fluid-style Programs (one engine, SURVEY §0); proto() returns the
+ModelConfig-shaped summary and serialize_for_inference emits the same
+PTIR + params artifact the modern io.save_inference_model produces."""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from .config_base import Layer
+
+
+def _listify(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Topology:
+    def __init__(self, layers, extra_layers=None):
+        self.outputs: List[Layer] = _listify(layers)
+        self.extra: List[Layer] = _listify(extra_layers)
+        for lay in self.outputs + self.extra:
+            if not isinstance(lay, Layer):
+                raise ValueError(
+                    f"Topology expects v2 config_base.Layer nodes, got "
+                    f"{type(lay).__name__}")
+
+    # -- graph ---------------------------------------------------------
+    def nodes(self) -> List[Layer]:
+        seen: Dict[int, Layer] = {}
+        order: List[Layer] = []
+        for out in self.outputs + self.extra:
+            for n in out.ancestors():
+                if id(n) not in seen:
+                    seen[id(n)] = n
+                    order.append(n)
+        return order
+
+    def data_layers(self) -> List[Layer]:
+        return [n for n in self.nodes() if n.type == "data"]
+
+    def data_type(self):
+        """[(name, InputType)] in feeding order (reference
+        topology.py:118)."""
+        return [(d.name, d.data_type) for d in self.data_layers()]
+
+    def get_layer(self, name: str) -> Layer:
+        for n in self.nodes():
+            if n.name == name:
+                return n
+        raise ValueError(f"no layer named {name!r} in topology")
+
+    # -- lowering ------------------------------------------------------
+    def programs(self, optimizer=None, is_test=False):
+        """Lower the DAG into fresh (main, startup) Programs; returns
+        (main, startup, {layer_name: fluid var}) for the outputs and
+        data layers. `optimizer` (a v2 optimizer.Optimizer) appends its
+        update pass on the FIRST output (the cost). is_test=True flips
+        train-mode ops to inference (BN moving stats, dropout identity)
+        via the program-level inference_optimize transform — the same
+        mechanism save_inference_model uses."""
+        import paddle_tpu as pt
+        from ..framework import isolated_name_scope
+
+        main, startup = pt.Program(), pt.Program()
+        ctx: Dict[int, object] = {}
+        fetches: Dict[str, object] = {}
+        # isolated_name_scope: every lowering of this topology (train /
+        # test / infer programs) must produce IDENTICAL auto param
+        # names, or they could not share one Parameters scope
+        with pt.program_guard(main, startup), isolated_name_scope():
+            for node in self.outputs + self.extra:
+                fetches[node.name] = node.to_var(ctx)
+            for d in self.data_layers():
+                fetches[d.name] = d.to_var(ctx)
+            if optimizer is not None:
+                cost_var = fetches[self.outputs[0].name]
+                optimizer.to_fluid().minimize(cost_var)
+        if is_test:
+            main = main.inference_optimize()
+        return main, startup, fetches
+
+    # -- artifacts -----------------------------------------------------
+    def proto(self) -> dict:
+        """ModelConfig-shaped summary of the lowered graph."""
+        main, _s, _f = self.programs()
+        return {
+            "layers": [{"name": n.name, "type": n.type,
+                        "inputs": [p.name for p in n.parents]}
+                       for n in self.nodes()],
+            "parameters": [{"name": p.name, "shape": list(p.shape)}
+                           for p in main.all_parameters()],
+            "input_layer_names": [d.name for d in self.data_layers()],
+            "output_layer_names": [o.name for o in self.outputs],
+        }
+
+    def serialize_for_inference(self, stream) -> None:
+        """Write the proto summary as JSON (reference writes the binary
+        ModelConfig; the PTIR+params inference artifact itself comes
+        from io.save_inference_model on the lowered program)."""
+        stream.write(json.dumps(self.proto()).encode())

@@ -1,0 +1,122 @@
+"""v2 SGD trainer (reference: python/paddle/v2/trainer.py:37 — combines
+cost topology + Parameters + optimizer; train() pumps a reader through
+forward/backward firing events; test() evaluates).
+
+TPU-native: the topology lowers once onto Programs, the jit-compiled
+Executor step runs against the Parameters' scope (so the Parameters
+object the user holds IS the live state), and the event loop stays on
+the host — same engine as the modern API, per SURVEY §0."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from . import event as v2_event
+from . import optimizer as v2_optimizer
+from . import parameters as v2_parameters
+from .data_type import DataType, SequenceType
+from .topology import Topology
+
+
+class SGD:
+    def __init__(self, cost, parameters, update_equation,
+                 extra_layers=None, is_local=True, pserver_spec=None,
+                 use_etcd=True):
+        if not isinstance(parameters, v2_parameters.Parameters):
+            raise TypeError("parameters should be "
+                            "paddle.v2.parameters.Parameters")
+        if not isinstance(update_equation, v2_optimizer.Optimizer):
+            raise TypeError("update equation parameter must be "
+                            "paddle.v2.optimizer.Optimizer")
+        import paddle_tpu as pt
+
+        self.__topology__ = Topology(cost, extra_layers=extra_layers)
+        self.__parameters__ = parameters
+        self.__optimizer__ = update_equation
+        self._scope = parameters.scope
+
+        # Lower WITH the optimizer appended; sync any state the
+        # trainer's startup creates (optimizer accumulators, BN stats)
+        # into the parameters scope without clobbering values the user
+        # already holds (reference: Parameters.append_gradient_machine
+        # copies user arrays INTO the machine).
+        self._main, startup, self._fetches = \
+            self.__topology__.programs(optimizer=update_equation)
+        parameters.adopt(self._main)
+        from ..core.scope import Scope
+        tmp = Scope()
+        pt.Executor().run(startup, scope=tmp)
+        for name in list(tmp.local_names()):
+            if not self._scope.has(name):
+                self._scope.set(name, tmp.get(name))
+        self._exe = pt.Executor()
+        # fetch the LOWERED var (node names are v2-graph names; the
+        # fluid vars carry their own auto names)
+        self._cost_var = self._fetches[self.__topology__.outputs[0].name]
+        self._test_prog = None  # memoized forward-only lowering
+
+    # -- feeding ------------------------------------------------------
+    def _feeder(self, feeding: Optional[dict]):
+        from ..data_feeder import DataFeeder
+
+        data_layers = self.__topology__.data_layers()
+        if feeding:
+            by_index = sorted(
+                (idx, name) for name, idx in feeding.items())
+            names = [n for _i, n in by_index]
+            order = {d.name: d for d in data_layers}
+            data_layers = [order[n] for n in names if n in order]
+        main_block = self._main.global_block()
+        feed_vars = [main_block.var(d.name) for d in data_layers]
+        return DataFeeder(feed_vars)
+
+    # -- the event loop (reference trainer.py:137) --------------------
+    def train(self, reader, num_passes=1, event_handler=None,
+              feeding=None):
+        event_handler = event_handler or (lambda e: None)
+        feeder = self._feeder(feeding)
+        batch_id_total = 0
+        for pass_id in range(num_passes):
+            event_handler(v2_event.BeginPass(pass_id))
+            costs = []
+            for batch_id, batch in enumerate(reader()):
+                event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                feed = feeder.feed(batch)
+                (cost,) = self._exe.run(self._main, feed=feed,
+                                        fetch_list=[self._cost_var],
+                                        scope=self._scope)
+                cost = float(np.asarray(cost).ravel()[0])
+                costs.append(cost)
+                event_handler(v2_event.EndIteration(
+                    pass_id, batch_id, cost, metrics={}))
+                batch_id_total += 1
+            event_handler(v2_event.EndPass(
+                pass_id, metrics={"cost": float(np.mean(costs))
+                                  if costs else float("nan")}))
+
+    def test(self, reader, feeding=None) -> v2_event.TestResult:
+        """Average cost over the reader WITHOUT updating parameters:
+        evaluates through a forward-only, inference-mode lowering (BN
+        moving stats, dropout identity) of the same topology against
+        the same scope. The lowering is built once and memoized —
+        per-pass test() calls must not retrace/recompile."""
+        if self._test_prog is None:
+            self._test_prog = self.__topology__.programs(is_test=True)
+        main, _startup, fetches = self._test_prog
+        cost_var = fetches[self.__topology__.outputs[0].name]
+        feeder = self._feeder(feeding)
+        costs, weights = [], []
+        for batch in reader():
+            feed = feeder.feed(batch)
+            (cost,) = self._exe.run(main, feed=feed,
+                                    fetch_list=[cost_var],
+                                    scope=self._scope)
+            costs.append(float(np.asarray(cost).ravel()[0]))
+            weights.append(len(batch))
+        avg = (float(np.average(costs, weights=weights))
+               if costs else float("nan"))
+        return v2_event.TestResult(cost=avg)
+
+    def save_parameter_to_tar(self, f) -> None:
+        self.__parameters__.to_tar(f)

@@ -52,6 +52,79 @@ def test_classification_against_mesh_axes():
     assert out[3].axes == ("seq",)
 
 
+def test_classification_composite_and_local_permutes():
+    """GSPMD resharding emits permutes whose pairs differ in TWO mesh
+    coordinates (an axis swap, e.g. (s=1,m=0)<->(s=0,m=1)) plus
+    identity self-pairs; the classifier must attribute them to the
+    composite axis set, and tag all-self permutes as local."""
+    from paddle_tpu.parallel import make_mesh
+    import jax
+    mesh = make_mesh((2, 2, 2), ("data", "seq", "model"),
+                     devices=jax.devices()[:8])
+    # the exact pattern from the transformer dryrun: 1<->2, 5<->6 swap
+    # seq and model coords inside each data row; rest are self-pairs
+    c1 = ca.Collective("collective-permute", 4,
+                       pairs=[(0, 0), (2, 1), (1, 2), (3, 3),
+                              (4, 4), (6, 5), (5, 6), (7, 7)])
+    c2 = ca.Collective("collective-permute", 4,
+                       pairs=[(0, 0), (1, 1), (2, 2), (3, 3)])
+    # grouped collective with singleton groups only: also local
+    c3 = ca.Collective("all-gather", 4, groups=[[0], [1], [2], [3]])
+    # all-reduce with no replica_groups attr: all devices, all axes
+    c4 = ca.Collective("all-reduce", 4)
+    out = ca.classify([c1, c2, c3, c4], mesh)
+    assert out[0].axes == ("seq", "model")
+    assert out[1].axes == ("local",)
+    assert out[2].axes == ("local",)
+    assert out[3].axes == ("data", "seq", "model")
+
+
+def test_assert_collectives_strict_bytes_and_forbid():
+    inv = {("all-reduce", ("data",)): (3, 1000),
+           ("collective-permute", ("seq",)): (2, 64)}
+    # min_bytes honoured
+    ca.assert_collectives(inv, [(("all-reduce",), "data", 900)])
+    with pytest.raises(AssertionError, match="bytes"):
+        ca.assert_collectives(inv, [(("all-reduce",), "data", 2000)])
+    # forbid rejects a misrouted collective
+    with pytest.raises(AssertionError, match="forbidden"):
+        ca.assert_collectives(inv, [], forbid=[
+            (("collective-permute",), "seq")])
+    # any unattributed row fails the audit unconditionally
+    bad = dict(inv)
+    bad[("collective-permute", ("?",))] = (97, 12345)
+    with pytest.raises(AssertionError, match="unattributed"):
+        ca.assert_collectives(bad, [(("all-reduce",), "data")])
+
+
+def test_audit_rejects_misrouted_ring_layout():
+    """End-to-end misroute detection: ring attention deliberately run
+    over the WRONG mesh axis compiles to permutes on that axis; the
+    audit asserting 'permutes must ride seq, none may ride data'
+    rejects the layout."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.context_parallel import (
+        sequence_parallel_attention)
+
+    mesh = make_mesh((2, 2), ("seq", "data"), devices=jax.devices()[:4])
+    B, H, S, D = 2, 2, 32, 8
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+
+    def misrouted(q, k, v):
+        return sequence_parallel_attention(q, k, v, mesh, axis="data",
+                                           impl="ring", causal=True)
+
+    hlo = jax.jit(misrouted).lower(q, q, q).compile().as_text()
+    inv = ca.inventory(hlo, mesh)
+    with pytest.raises(AssertionError):
+        ca.assert_collectives(
+            inv, [(("collective-permute",), "seq")],
+            forbid=[(("collective-permute",), "data")])
+
+
 def test_assert_collectives_accepts_merged_axes_and_fails_on_missing():
     inv = {("all-reduce", ("data", "seq")): (3, 1000),
            ("collective-permute", ("pipe",)): (2, 64)}

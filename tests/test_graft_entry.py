@@ -14,6 +14,12 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# The dryrun's scaling-model section (bench-shape 64-device compiles,
+# ~4 min) has its own dedicated test (tests/test_scaling_model.py);
+# these driver-contract tests turn it off to keep the suite's wall
+# clock sane. Subprocess fallbacks inherit the env var.
+os.environ["PADDLE_TPU_DRYRUN_SCALING"] = "0"
+
 
 def test_dryrun_8_inprocess_matches_conftest_devices():
     # conftest pins 8 virtual CPU devices, so n=8 runs fully in-process.

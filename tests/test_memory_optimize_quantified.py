@@ -1,0 +1,96 @@
+"""Quantified memory_optimize benefit (round-3 VERDICT item 8;
+reference motivating case: memory_optimization_transpiler.py:332 +
+tests/book_memory_optimization/test_memopt_machine_translation.py — a
+long unrolled RNN must fit memory).
+
+Two numbers on the same 160-step unrolled RNN:
+  1. TRACE-time peak live-tracer bytes (the lowering-side cost this
+     design actually pays) — the pass must cut it by >5x.
+  2. Compiled-XLA temp-buffer peak (memory_analysis) — expected ~equal
+     WITH or WITHOUT the pass, because XLA's buffer assignment already
+     does liveness reuse inside the executable; the measured delta is
+     recorded so the "subsumed by XLA" claim is evidence, not
+     assertion (MFU_BREAKDOWN.md §memory_optimize)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+STEPS, B, H = 160, 32, 512
+
+
+def _build_unrolled():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [H], dtype="float32")
+        h = x
+        for _ in range(STEPS):
+            h = layers.fc(h, size=H, act="tanh")
+        loss = layers.mean(h)
+    return main, startup, loss
+
+
+def _trace_peak_and_compiled_temp(optimize: bool):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.executor import (_collect_state_names,
+                                          trace_block)
+
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    main, startup, loss = _build_unrolled()
+    stats = None
+    if optimize:
+        stats = pt.memory_optimize(main)
+        assert stats["released_vars"] > STEPS  # pass actually fired
+    exe = pt.Executor()
+    exe.run(startup)
+    scope = pt.global_scope()
+    block = main.desc.global_block
+    read_names, _w = _collect_state_names(main.desc, block, scope)
+    state = {n: scope.get(n) for n in read_names}
+
+    trace_stats = {}
+
+    def fn(params, xv):
+        env = dict(params)
+        env["x"] = xv
+        extra = {"program": main.desc,
+                 "step": jnp.zeros((), jnp.int32),
+                 "keep_vars": {loss.name},
+                 "trace_stats": trace_stats,
+                 "prng": lambda seed: jax.random.PRNGKey(seed)}
+        env = trace_block(block, env, extra)
+        return env[loss.name]
+
+    xv = np.zeros((B, H), np.float32)
+    compiled = jax.jit(fn).lower(state, xv).compile()
+    mem = compiled.memory_analysis()
+    temp = int(getattr(mem, "temp_size_in_bytes", 0))
+    return trace_stats["peak_env_bytes"], temp
+
+
+def test_memory_optimize_quantified():
+    peak_plain, temp_plain = _trace_peak_and_compiled_temp(False)
+    peak_opt, temp_opt = _trace_peak_and_compiled_temp(True)
+
+    act_bytes = B * H * 4
+    # weights are read-state and stay live regardless; the pass acts on
+    # the ACTIVATION component of the live set (fc emits 3 temps/step:
+    # matmul out, bias out, tanh out)
+    param_bytes = STEPS * (H * H + H) * 4
+    acts_plain = peak_plain - param_bytes
+    acts_opt = peak_opt - param_bytes
+    # without the pass every step's temps stay live at trace time
+    assert acts_plain > 3 * STEPS * act_bytes * 0.9, acts_plain
+    # with it, only a bounded window of steps is ever live
+    assert acts_opt < acts_plain / 10, (acts_plain, acts_opt)
+    assert acts_opt < 20 * act_bytes, acts_opt
+
+    # XLA buffer reuse happens either way: the pass must not COST
+    # compiled memory; equality is the expected "subsumed by XLA"
+    # result, and the numbers document it.
+    assert temp_opt <= temp_plain * 1.05, (temp_plain, temp_opt)
+    print(f"trace peak: {peak_plain/1e6:.1f} MB -> {peak_opt/1e6:.1f} "
+          f"MB; XLA temp: {temp_plain/1e6:.1f} MB -> "
+          f"{temp_opt/1e6:.1f} MB")

@@ -1,0 +1,292 @@
+"""Runnable v2 layer-object API (reference:
+python/paddle/v2/tests/test_layer.py usage style + the v2 train loop of
+python/paddle/v2/trainer.py:137): graphs built from layer objects,
+Topology/parameters.create/SGD.train/infer must execute end-to-end
+against the TPU-native engine."""
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.v2 import activation, attr, data_type, layer, networks
+from paddle_tpu.v2 import pooling
+
+
+def _img_graph():
+    pixel = layer.data(name="pixel",
+                       type=data_type.dense_vector(128))
+    label = layer.data(name="label", type=data_type.integer_value(10))
+    hidden = layer.fc(input=pixel, size=100, act=activation.Sigmoid(),
+                      param_attr=attr.Param(name="hidden"))
+    inference = layer.fc(input=hidden, size=10,
+                         act=activation.Softmax())
+    conv = layer.img_conv(input=pixel, filter_size=1, filter_size_y=1,
+                          num_channels=8, num_filters=16,
+                          act=activation.Linear())
+    return pixel, label, hidden, inference, conv
+
+
+def test_img_layers_parse_network():
+    """Reference ImageLayerTest: conv / pooling / spp / maxout / norm
+    layers parse into a network summary with real parameters."""
+    pixel, label, hidden, inference, conv = _img_graph()
+    maxpool = layer.img_pool(input=conv, pool_size=2, num_channels=16,
+                             padding=1, pool_type=pooling.Max())
+    spp = layer.spp(input=conv, pyramid_height=2, num_channels=16,
+                    pool_type=pooling.Max())
+    maxout = layer.maxout(input=conv, num_channels=16, groups=4)
+    norm1 = layer.img_cmrnorm(input=conv, size=5)
+    norm2 = layer.batch_norm(input=conv)
+    norm3 = layer.sum_to_one_norm(input=conv)
+    net = layer.parse_network([maxpool, spp, maxout, norm1, norm2,
+                               norm3])
+    types = {entry["type"] for entry in net["layers"]}
+    assert {"img_pool", "spp", "maxout", "img_cmrnorm", "batch_norm",
+            "sum_to_one_norm", "img_conv", "data"} <= types
+    assert net["input_layer_names"] == ["pixel"]
+    assert any(p["name"].startswith("__img_conv")
+               for p in net["parameters"])
+
+
+def test_aggregate_and_misc_layers_parse():
+    """Reference AggregateLayerTest + OtherLayerTest style."""
+    pixel, label, hidden, inference, conv = _img_graph()
+    score = layer.data(name="score", type=data_type.dense_vector(1))
+    seq = layer.data(name="seq",
+                     type=data_type.dense_vector_sequence(128))
+    pool = layer.pooling(input=seq, pooling_type=pooling.Avg(),
+                         agg_level=layer.AggregateLevel.TO_NO_SEQUENCE)
+    last = layer.last_seq(input=seq)
+    first = layer.first_seq(input=seq)
+    concat = layer.concat(input=[last, first])
+    cos = layer.cos_sim(a=hidden, b=hidden)
+    shift = layer.conv_shift(a=pixel, b=score)
+    maxid = layer.max_id(input=inference)
+    net = layer.parse_network([pool, concat, cos, shift, maxid])
+    types = {entry["type"] for entry in net["layers"]}
+    assert {"pooling", "last_seq", "first_seq", "concat", "cos_sim",
+            "conv_shift", "max_id"} <= types
+
+
+def test_cost_layers_parse():
+    pixel, label, hidden, inference, conv = _img_graph()
+    weight = layer.data(name="weight", type=data_type.dense_vector(1))
+    cost1 = layer.classification_cost(input=inference, label=label)
+    cost2 = layer.classification_cost(input=inference, label=label,
+                                      weight=weight)
+    cost3 = layer.square_error_cost(input=hidden, label=hidden)
+    net = layer.parse_network([cost1, cost2, cost3])
+    assert {"classification_cost", "square_error_cost"} <= {
+        entry["type"] for entry in net["layers"]}
+
+
+def _toy_reader(n=128, dim=16, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, dim) * 3
+
+    def reader():
+        for i in range(n):
+            c = i % classes
+            yield (centers[c] + rng.randn(dim)).astype(
+                np.float32).tolist(), c
+
+    return reader
+
+
+def test_v2_train_test_infer_and_tar_roundtrip():
+    """The reference v2 workflow end-to-end: parameters.create ->
+    trainer.SGD.train(events) -> trainer.test -> infer -> to_tar /
+    init_from_tar."""
+    x = layer.data(name="x", type=data_type.dense_vector(16))
+    y = layer.data(name="y", type=data_type.integer_value(4))
+    hidden = layer.fc(input=x, size=32, act=activation.Tanh())
+    out = layer.fc(input=hidden, size=4, act=activation.Softmax())
+    cost = layer.classification_cost(input=out, label=y)
+
+    parameters = paddle.parameters.create(cost)
+    assert any(k == "hidden" or k.endswith(".w0") or "fc" in k
+               for k in parameters.keys())
+    optimizer = paddle.optimizer.Momentum(momentum=0.9,
+                                          learning_rate=0.05)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=optimizer)
+
+    events = []
+    costs = []
+
+    def handler(e):
+        events.append(type(e).__name__)
+        if isinstance(e, paddle.event.EndIteration):
+            costs.append(e.cost)
+
+    trainer.train(reader=paddle.batch(_toy_reader(), batch_size=32),
+                  num_passes=8, event_handler=handler)
+    assert "BeginPass" in events and "EndPass" in events
+    assert "EndIteration" in events
+    assert np.mean(costs[-4:]) < 0.5 * np.mean(costs[:4]), (
+        costs[:4], costs[-4:])
+
+    result = trainer.test(
+        reader=paddle.batch(_toy_reader(seed=1), batch_size=32))
+    assert np.isfinite(result.cost)
+
+    # inference over raw samples
+    samples = [s for s, _lbl in _toy_reader(n=8)()]
+    labels = [lbl for _s, lbl in _toy_reader(n=8)()]
+    probs = paddle.infer(output_layer=out, parameters=parameters,
+                         input=[(s,) for s in samples])
+    assert probs.shape == (8, 4)
+    acc = np.mean(np.argmax(probs, axis=1) == np.asarray(labels))
+    assert acc >= 0.75, acc
+
+    # tar round-trip reproduces the same inference
+    buf = io.BytesIO()
+    parameters.to_tar(buf)
+    buf.seek(0)
+    restored = paddle.parameters.Parameters.from_tar(buf)
+    assert sorted(restored.keys()) == sorted(parameters.keys())
+    probs2 = paddle.infer(output_layer=out, parameters=restored,
+                          input=[(s,) for s in samples])
+    np.testing.assert_allclose(probs, probs2, rtol=1e-5)
+
+
+def test_v2_conv_network_trains():
+    """simple_img_conv_pool (mnist-style) over dense_vector images."""
+    rng = np.random.RandomState(0)
+    images = layer.data(name="pixel",
+                        type=data_type.dense_vector(1 * 12 * 12))
+    label = layer.data(name="label", type=data_type.integer_value(2))
+    conv = networks.simple_img_conv_pool(
+        input=images, filter_size=3, num_filters=4, pool_size=2,
+        pool_stride=2, act=activation.Relu(), num_channels=1,
+        padding=1)
+    out = layer.fc(input=conv, size=2, act=activation.Softmax())
+    cost = layer.classification_cost(input=out, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.01))
+
+    def reader():
+        for i in range(64):
+            c = i % 2
+            base = np.zeros((12, 12), np.float32)
+            if c:
+                base[3:9, 3:9] = 1.0
+            noisy = base + 0.1 * rng.randn(12, 12)
+            yield noisy.reshape(-1).astype(np.float32).tolist(), c
+
+    costs = []
+    trainer.train(
+        reader=paddle.batch(reader, batch_size=16), num_passes=4,
+        event_handler=lambda e: costs.append(e.cost) if isinstance(
+            e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0], (costs[0], costs[-1])
+
+
+def test_v2_sequence_lstm_trains():
+    """integer_value_sequence -> embedding -> simple_lstm -> pooling:
+    the ragged v2 path (reference understand_sentiment usage)."""
+    rng = np.random.RandomState(0)
+    V = 20
+    words = layer.data(name="words",
+                       type=data_type.integer_value_sequence(V))
+    label = layer.data(name="label", type=data_type.integer_value(2))
+    emb = layer.embedding(input=words, size=8)
+    lstm = networks.simple_lstm(input=emb, size=8)
+    pooled = layer.pooling(input=lstm, pooling_type=pooling.Max())
+    out = layer.fc(input=pooled, size=2, act=activation.Softmax())
+    cost = layer.classification_cost(input=out, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+
+    def reader():
+        for i in range(48):
+            c = i % 2
+            length = rng.randint(3, 7)
+            lo, hi = (1, V // 2) if c == 0 else (V // 2, V)
+            yield rng.randint(lo, hi, length).tolist(), c
+
+    costs = []
+    trainer.train(
+        reader=paddle.batch(reader, batch_size=12), num_passes=6,
+        event_handler=lambda e: costs.append(e.cost) if isinstance(
+            e, paddle.event.EndIteration) else None)
+    assert np.mean(costs[-4:]) < np.mean(costs[:4])
+
+
+def test_v2_topology_and_init():
+    x = layer.data(name="x", type=data_type.dense_vector(8))
+    out = layer.fc(input=x, size=4, act=activation.Softmax())
+    topo = paddle.topology.Topology(out)
+    assert [d.name for d in topo.data_layers()] == ["x"]
+    name, t = topo.data_type()[0]
+    assert name == "x" and t.dim == 8
+    buf = io.BytesIO()
+    topo.serialize_for_inference(buf)
+    assert b"output_layer_names" in buf.getvalue()
+    paddle.init(use_gpu=False, trainer_count=1)
+    assert paddle.init.last_args["trainer_count"] == 1
+
+
+def test_v2_infer_is_deterministic_with_dropout():
+    """Round-4 review fix: trainer.test()/infer must lower in
+    inference mode — dropout identity, BN moving stats — so repeated
+    inference on the same input is bit-identical."""
+    x = layer.data(name="x", type=data_type.dense_vector(8))
+    h = layer.fc(input=x, size=16, act=activation.Relu())
+    d = layer.dropout(input=h, dropout_rate=0.5)
+    out = layer.fc(input=d, size=3, act=activation.Softmax())
+    cost = layer.classification_cost(
+        input=out,
+        label=layer.data(name="y", type=data_type.integer_value(3)))
+    parameters = paddle.parameters.create(cost)
+    sample = [(list(np.linspace(-1, 1, 8)),)]
+    p1 = paddle.infer(output_layer=out, parameters=parameters,
+                      input=sample)
+    p2 = paddle.infer(output_layer=out, parameters=parameters,
+                      input=sample)
+    np.testing.assert_array_equal(p1, p2)
+
+
+def test_v2_sequence_conv_pool_uses_context_window():
+    """sequence_conv_pool must build a real context-window conv, not a
+    plain per-timestep projection: a window-order-sensitive pattern is
+    only separable with context_len > 1."""
+    from paddle_tpu.v2 import networks as nets
+    words = layer.data(name="w",
+                       type=data_type.dense_vector_sequence(4))
+    pooled = nets.sequence_conv_pool(input=words, context_len=3,
+                                     hidden_size=8)
+    out = layer.fc(input=pooled, size=2, act=activation.Softmax())
+    cost = layer.classification_cost(
+        input=out,
+        label=layer.data(name="y", type=data_type.integer_value(2)))
+    net = layer.parse_network(cost)
+    assert "sequence_conv" in {e["type"] for e in net["layers"]}
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+    rng = np.random.RandomState(0)
+
+    def reader():
+        base = np.eye(4, dtype=np.float32)
+        for i in range(40):
+            c = i % 2
+            # class = direction of the one-hot staircase (order info)
+            idx = [0, 1, 2, 3] if c else [3, 2, 1, 0]
+            seq = [base[j].tolist() for j in idx]
+            yield seq, c
+
+    costs = []
+    trainer.train(
+        reader=paddle.batch(reader, batch_size=10), num_passes=6,
+        event_handler=lambda e: costs.append(e.cost) if isinstance(
+            e, paddle.event.EndIteration) else None)
+    assert np.mean(costs[-4:]) < 0.5 * np.mean(costs[:4]), costs
