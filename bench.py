@@ -42,6 +42,13 @@ BASELINE_LSTM_TOKENS_PER_SEC = 64 * 100 / 0.184
 # matches that protocol (with_aux=False, bs128).
 BASELINE_ALEXNET_IPS = 128 / 0.334
 BASELINE_GOOGLENET_IPS = 264.83
+# VGG anchor (VERDICT r3 item 9): the reference's best published VGG
+# training number at our bench batch — VGG-19 MKL-DNN bs64, 28.46 img/s
+# (IntelOptimizedPaddle.md:30-36). Caveat: that table is VGG-*19*
+# (~1.26x the conv FLOPs of our VGG-16 bench model), so the ratio is
+# flattering by up to that factor; the MFU field is the calibrated
+# efficiency number.
+BASELINE_VGG_IPS = 28.46
 
 # MFU accounting (north star: >=50% MFU ResNet-50): v5e peak bf16
 # throughput per chip. ResNet-50 forward is ~4.1 GMAC/image at 224^2;
@@ -51,6 +58,10 @@ BASELINE_GOOGLENET_IPS = 264.83
 # bs128 = 24.1 GFLOP/image (MFU_BREAKDOWN.md).
 V5E_PEAK_FLOPS = 197e12
 RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 2 * 4.1e9
+# VGG-16 train step: XLA cost analysis of the compiled bs64 train
+# program measures 5.808e12 flops = 90.76 GFLOP/image (cross-check:
+# 15.5 GMAC/image fwd * 2 flops/MAC * ~3 passes = 93e9).
+VGG16_TRAIN_FLOPS_PER_IMAGE = 90.76e9
 # transformer-base MFU via the 6*N*D rule (N ~= 98M params incl.
 # embeddings for the bench config: 6 enc + 6 dec layers, d512, 32k vocab)
 TRANSFORMER_FLOPS_PER_TOKEN = 6 * 98e6
@@ -65,7 +76,7 @@ REPEATS = int(os.environ.get("BENCH_REPEATS", "2"))
 
 
 def _marginal_steps_per_sec(exe, program, feed, loss_var, n1=None,
-                            n2=None, repeats=None):
+                            n2=None, repeats=None, iterations=1):
     """Marginal steps/sec via two synced runs of different lengths.
 
     With repeats > 1, the (n1, n2) pair is measured that many times and
@@ -78,7 +89,14 @@ def _marginal_steps_per_sec(exe, program, feed, loss_var, n1=None,
     same computation, which the tunnel serves from cache (the round-3
     inference-accounting bug); cycling distinct resident batches keeps
     every step real compute. Stateful programs chain donated state, so
-    a single feed is fine there."""
+    a single feed is fine there.
+
+    `iterations` > 1 compiles K real steps into each dispatch
+    (Executor.run(iterations=K), a lax.scan over the step): ms-scale
+    steps were unmeasurable through the tunnel at ANY window length
+    (BENCH_r03 spreads 21-66%) because per-dispatch jitter is the same
+    order as the whole window; in-graph looping amortizes dispatch
+    1/K. Returned steps/sec counts INNER steps."""
     n1 = n1 or N1
     n2 = n2 or N2
     repeats = repeats if repeats is not None else REPEATS
@@ -88,7 +106,8 @@ def _marginal_steps_per_sec(exe, program, feed, loss_var, n1=None,
 
     def one_step():
         (out,) = exe.run(program, feed=feeds[step_i[0] % len(feeds)],
-                         fetch_list=[loss_var], return_numpy=False)
+                         fetch_list=[loss_var], return_numpy=False,
+                         iterations=iterations)
         step_i[0] += 1
         return out
 
@@ -113,14 +132,14 @@ def _marginal_steps_per_sec(exe, program, feed, loss_var, n1=None,
             raise RuntimeError(
                 f"marginal timing invalid: t({n2})={t2:.3f}s <= "
                 f"t({n1})={t1:.3f}s — timing not steady-state")
-        ests.append((n2 - n1) / (t2 - t1))
+        ests.append((n2 - n1) * iterations / (t2 - t1))
     med = float(np.median(ests))
     spread = (max(ests) - min(ests)) / med if len(ests) > 1 else 0.0
     return med, spread
 
 
 def _bench_image_model(pt, build, batch, image_shape, num_classes,
-                       n1=None, n2=None, repeats=None):
+                       n1=None, n2=None, repeats=None, iterations=1):
     """Shared image-classification harness: build, init, frozen random
     feed (frozen owning arrays are cached device-side by the executor,
     so steady-state steps measure compute, not host-link re-uploads of
@@ -135,7 +154,8 @@ def _bench_image_model(pt, build, batch, image_shape, num_classes,
     label.flags.writeable = False
     feed = {"img": img, "label": label}
     sps, spread = _marginal_steps_per_sec(exe, main_p, feed, f["loss"],
-                                          n1=n1, n2=n2, repeats=repeats)
+                                          n1=n1, n2=n2, repeats=repeats,
+                                          iterations=iterations)
     return batch * sps, spread
 
 
@@ -168,6 +188,97 @@ def _ensure_bench_shards(n_images=512, shards=4):
             recs.append(struct.pack("<q", label) + img.tobytes())
         write_recordio(recs, p)
     return paths
+
+
+def _mp_pipeline_worker(widx, nworkers, master_ep=None, batch=128):
+    """Batch producer for one pipeline worker PROCESS (top-level so the
+    spawn start method can pickle it by reference): pulls shard tasks
+    from the master service (reference: Go master data dispatch,
+    go/master/service.go GetTask), streams records through the native
+    threaded recordio loader, decodes into a reusable uint8 batch."""
+    import struct
+
+    from paddle_tpu.distributed.master import MasterClient
+    from paddle_tpu.recordio import DataLoader
+
+    def read_shard(payload):
+        dl = DataLoader([payload.decode()], num_threads=2, epochs=1,
+                        queue_capacity=256)
+        try:
+            yield from dl
+        finally:
+            dl.close()
+
+    def records():
+        cli = MasterClient(master_ep)
+        while True:
+            yield from cli.task_reader(read_shard)
+            cli.new_pass()
+
+    imgs = np.empty((batch, 3, 224, 224), np.uint8)
+    labels = np.empty((batch, 1), np.int64)
+    i = 0
+    for rec in records():
+        labels[i, 0] = struct.unpack("<q", rec[:8])[0]
+        imgs[i] = np.frombuffer(rec[8:], np.uint8).reshape(3, 224, 224)
+        i += 1
+        if i == batch:
+            yield imgs, labels
+            i = 0
+
+
+def _mp_noop_worker(widx, nworkers, batch=128):
+    """Zero-decode producer: measures the shared-memory transport
+    ceiling alone (slot memcpy + two queue messages per batch)."""
+    imgs = np.zeros((batch, 3, 224, 224), np.uint8)
+    labels = np.zeros((batch, 1), np.int64)
+    while True:
+        yield imgs, labels
+
+
+def _measure_reader_ips(reader, batch, n=16, warmup=2):
+    it = iter(reader())
+    for _ in range(warmup):
+        next(it)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        next(it)
+    dt = time.perf_counter() - t0
+    it.close()
+    return batch * n / dt
+
+
+def bench_host_pipeline_mp(pt):
+    """Multi-process host input pipeline (VERDICT r3 item 5): N worker
+    processes pull shard tasks from the master service and stream
+    decoded batches back through shared-memory ring slots. Also
+    measures the transport ceiling (no-op decode) — the number that
+    separates 'the pipeline design caps out' from 'this host has few
+    cores'. On a 1-core bench host the N-worker aggregate is
+    core-bound by construction; per-worker parity with the
+    single-process pipeline plus a transport ceiling >= 3x compute is
+    the evidence that the pipeline scales with cores on a production
+    host."""
+    from paddle_tpu.distributed.master import Master, MasterServer
+    from paddle_tpu.reader import multiprocess_batch_reader
+
+    paths = _ensure_bench_shards()
+    nw = max(2, min(4, (os.cpu_count() or 1)))
+    master = Master(timeout_s=120.0)
+    master.set_dataset([p.encode() for p in paths])
+    srv = MasterServer(master).start()
+    try:
+        reader = multiprocess_batch_reader(
+            _mp_pipeline_worker, nw, slots_per_worker=4, method="spawn",
+            worker_kwargs={"master_ep": srv.endpoint, "batch": BATCH})
+        mp_ips = _measure_reader_ips(reader, BATCH)
+    finally:
+        srv.shutdown()
+    ceiling_reader = multiprocess_batch_reader(
+        _mp_noop_worker, 2, slots_per_worker=4, method="spawn",
+        worker_kwargs={"batch": BATCH})
+    ceiling_ips = _measure_reader_ips(ceiling_reader, BATCH)
+    return mp_ips, nw, ceiling_ips
 
 
 def bench_resnet_real_input(pt):
@@ -330,10 +441,12 @@ def bench_mnist(pt):
     """MNIST conv training (BASELINE config 1; tests/book
     recognize_digits)."""
     from paddle_tpu.models import mnist
-    # ~2ms steps: very long windows or the spread is all tunnel jitter
+    # ~2ms steps: even 360-step windows posted 66% spread (BENCH_r03) —
+    # per-dispatch tunnel jitter is the same order as the window. 64
+    # steps per compiled dispatch (lax.scan) amortizes it away.
     return _bench_image_model(
         pt, mnist.build_train, 512, (1, 28, 28), 10,
-        n1=60, n2=360, repeats=3)
+        n1=5, n2=25, repeats=3, iterations=64)
 
 
 def bench_deepfm(pt):
@@ -354,8 +467,11 @@ def bench_deepfm(pt):
     }
     for v in feed.values():
         v.flags.writeable = False
+    # in-graph 64-step loop: ~2ms steps are tunnel-jitter-bound at any
+    # window length (BENCH_r03 spread 32.6%)
     sps, spread = _marginal_steps_per_sec(exe, main_p, feed, f["loss"],
-                                          n1=60, n2=360, repeats=3)
+                                          n1=5, n2=25, repeats=3,
+                                          iterations=64)
     return b * sps, spread
 
 
@@ -413,10 +529,11 @@ def bench_lstm_lm(pt):
     lens.flags.writeable = False
     feed = {"words": RaggedPair(ids, lens),
             "targets": RaggedPair(ids, lens)}
-    # LSTM steps are ~ms-scale: use longer runs so the marginal delta
-    # dwarfs tunnel jitter
+    # LSTM steps are ~3ms: in-graph 32-step loop (BENCH_r03 spread at
+    # plain windows was 21.8%)
     sps, spread = _marginal_steps_per_sec(exe, main_p, feed, f["loss"],
-                                          n1=40, n2=240, repeats=3)
+                                          n1=5, n2=25, repeats=3,
+                                          iterations=32)
     return b * t * sps, spread
 
 
@@ -478,6 +595,10 @@ def main():
     def x_vgg():
         ips, sp = bench_vgg(pt)
         return {"vgg16_images_per_sec": round(ips, 0),
+                "vgg16_vs_baseline": round(ips / BASELINE_VGG_IPS, 2),
+                "vgg_mfu_est": round(
+                    ips * VGG16_TRAIN_FLOPS_PER_IMAGE / V5E_PEAK_FLOPS,
+                    3),
                 "vgg16_spread_pct": round(100 * sp, 1)}
 
     def x_alexnet():
@@ -511,17 +632,29 @@ def main():
 
     def x_real_input():
         real_ips, pipeline_ips = bench_resnet_real_input(pt)
+        mp_ips, mp_workers, ceiling_ips = bench_host_pipeline_mp(pt)
+        best = max(pipeline_ips, mp_ips)
         # host_pipeline_vs_compute > 1 means the pipeline keeps the chip
         # fed; the end-to-end number is TUNNEL-BOUND on this link (a
         # flat ~1-2.4s penalty per novel-argument execute that no input
         # design can avoid — MFU_BREAKDOWN.md); labeled so the artifact
-        # is self-describing
+        # is self-describing. host_cores contextualizes the mp number:
+        # N workers on a 1-core host time-slice one core, so the
+        # transport ceiling (no-op decode through the shared-memory
+        # rings) is the design's headroom bound there.
         return {"resnet50_real_input_images_per_sec": round(real_ips, 2),
                 "resnet50_real_input_tunnel_bound": True,
                 "host_input_pipeline_images_per_sec": round(
                     pipeline_ips, 2),
+                "host_pipeline_mp_images_per_sec": round(mp_ips, 2),
+                "host_pipeline_mp_workers": mp_workers,
+                "host_pipeline_transport_ceiling_images_per_sec": round(
+                    ceiling_ips, 2),
+                "host_cores": os.cpu_count(),
                 "host_pipeline_vs_compute": round(
-                    pipeline_ips / images_per_sec, 3)}
+                    best / images_per_sec, 3),
+                "host_transport_ceiling_vs_compute": round(
+                    ceiling_ips / images_per_sec, 3)}
 
     if os.environ.get("BENCH_TRANSFORMER", "1") == "1":
         _run_extra(pt, extras, amp_on, x_transformer)

@@ -365,6 +365,10 @@ class Executor:
         self.place = place
         self._cache: Dict[Tuple, CompiledProgram] = {}
         self._probe_cache: Dict[Tuple, Any] = {}
+        # stateful-op scan results for run(iterations=K), keyed by
+        # (program uid, version, block) — the walk is O(num_ops) and
+        # sits on the repeated-dispatch path
+        self._stateful_cache: Dict[Tuple, List[str]] = {}
         # bounded-While truncation flags from the PREVIOUS run, checked
         # one step later so the warn-by-default path never forces a
         # device sync on the just-dispatched step
@@ -427,7 +431,8 @@ class Executor:
     def _compile(self, program: Program, block: BlockDesc,
                  feed_sig, fetch_names: Sequence[str],
                  scope: Scope,
-                 while_bounds=None) -> CompiledProgram:
+                 while_bounds=None, iterations: int = 1,
+                 or_reduce_tail: int = 0) -> CompiledProgram:
         read_names, write_names = _collect_state_names(program, block, scope)
         fetch_names = list(fetch_names)
         # Donate only buffers that are overwritten (param updates); read-only
@@ -435,8 +440,8 @@ class Executor:
         rw_names = [n for n in read_names if n in set(write_names)]
         ro_names = [n for n in read_names if n not in set(write_names)]
 
-        def fn(feed_vals: Dict[str, Any], ro_state: Dict[str, Any],
-               rw_state: Dict[str, Any], step: jnp.ndarray):
+        def step_fn(feed_vals: Dict[str, Any], ro_state: Dict[str, Any],
+                    rw_state: Dict[str, Any], step: jnp.ndarray):
             env: Dict[str, Any] = {}
             env.update(ro_state)
             env.update(rw_state)
@@ -455,6 +460,54 @@ class Executor:
             new_state = {n: env[n] for n in write_names if n in env}
             return fetches, new_state
 
+        if iterations == 1:
+            fn = step_fn
+        else:
+            n_flags = int(or_reduce_tail)
+
+            def fn(feed_vals, ro_state, rw_state, step):
+                # K steps inside ONE compiled program (lax.scan over the
+                # traced step): per-dispatch overhead is paid once per K
+                # real steps, which is what makes ms-scale steps
+                # measurable through a high-RTT link. Each iteration
+                # consumes the same feed; rw state chains through the
+                # scan carry. Fetches and write-only state thread
+                # through the carry too (zero-init from eval_shape) —
+                # stacking K histories just to slice [-1] would cost
+                # K x device memory. The trailing `n_flags` fetches are
+                # bounded-While truncation flags: those OR across
+                # iterations, so a loop truncated at iteration 3 of 64
+                # still trips the check.
+                zeros = jax.tree_util.tree_map(
+                    lambda a: jnp.zeros(a.shape, a.dtype),
+                    jax.eval_shape(
+                        lambda rw, st: step_fn(feed_vals, ro_state,
+                                               rw, st),
+                        rw_state, step))
+                f0, ns0 = zeros
+                e0 = {n: v for n, v in ns0.items() if n not in rw_names}
+                first_flag = len(fetch_names) - n_flags
+
+                def body(carry, _):
+                    rw_c, st, f_c, _e_c = carry
+                    fetches, new_state = step_fn(feed_vals, ro_state,
+                                                 rw_c, st)
+                    rw_next = {n: new_state.get(n, rw_c[n])
+                               for n in rw_names}
+                    extra_w = {n: new_state.get(n, _e_c[n]) for n in e0}
+                    f_out = [
+                        jnp.logical_or(f_c[i], f) if i >= first_flag
+                        else f
+                        for i, f in enumerate(fetches)]
+                    return (rw_next, st + 1, f_out, extra_w), None
+
+                (rw_f, _, fetches, extra_w), _ = jax.lax.scan(
+                    body, (rw_state, step, f0, e0), xs=None,
+                    length=iterations)
+                new_state = dict(rw_f)
+                new_state.update(extra_w)
+                return fetches, new_state
+
         jitted = jax.jit(fn, donate_argnums=(2,))
 
         def call(feed_vals, state_vals, step):
@@ -469,11 +522,23 @@ class Executor:
     # ------------------------------------------------------------------
     def run(self, program: Program, feed: Optional[Dict[str, Any]] = None,
             fetch_list: Optional[Sequence] = None, scope: Optional[Scope] = None,
-            return_numpy: bool = True, block_idx: int = 0):
+            return_numpy: bool = True, block_idx: int = 0,
+            iterations: int = 1):
         """Execute `program` block `block_idx` with `feed`, return fetches.
 
         feed values: numpy arrays, python scalars, or LoDTensor for ragged.
         fetch_list entries: var names or objects with a `.name`.
+
+        iterations > 1 runs the block that many times inside ONE compiled
+        program (a lax.scan over the traced step, state chained through
+        the carry): the analog of the reference's repeated Executor.Run
+        over a prepared context (executor.cc RunPreparedContext), but
+        paying per-call dispatch once per K steps. Every iteration
+        consumes the same feed; fetches are the FINAL iteration's values;
+        the step counter advances by `iterations`. Rejected for programs
+        with host-side stateful ops (channels/select/go — host callbacks
+        under scan are unverified) or unbounded-While gradients (the trip
+        count is probed against the INITIAL state only).
         """
         if hasattr(program, "desc"):  # accept the python builder wrapper
             program = program.desc
@@ -509,14 +574,37 @@ class Executor:
         while_bounds = self._probe_while_bounds(
             program, block, feed_vals, feed_sig, scope, block_idx, step)
 
+        if iterations > 1:
+            if while_bounds:
+                raise RuntimeError(
+                    "iterations > 1 is incompatible with unbounded-While "
+                    "gradients: the trip-count probe measures the initial "
+                    "state only, but later scan iterations may need a "
+                    "larger bound. Run steps one at a time.")
+            skey = (program.uid, program.version, block_idx)
+            stateful = self._stateful_cache.get(skey)
+            if stateful is None:
+                stateful = _stateful_ops_in(program, block.ops)
+                self._stateful_cache[skey] = stateful
+            if stateful:
+                raise RuntimeError(
+                    f"iterations > 1 with stateful ops "
+                    f"{sorted(set(stateful))}: host-side channel/select/go "
+                    "callbacks inside a compiled scan are unverified. Run "
+                    "steps one at a time.")
+
         key = (program.uid, program.version, feed_sig, tuple(fetch_names),
                block_idx, amp_enabled(),
                tuple(sorted(while_bounds.items())) if while_bounds
-               else None)
+               else None, iterations)
         compiled = self._cache.get(key)
         if compiled is None:
+            kw = {} if iterations == 1 else {
+                "iterations": iterations,
+                "or_reduce_tail": len(exhausted)}
             compiled = self._compile(program, block, feed_sig, fetch_names,
-                                     scope, while_bounds=while_bounds)
+                                     scope, while_bounds=while_bounds,
+                                     **kw)
             self._cache[key] = compiled
 
         state_vals = {n: scope.get(n) for n in compiled.read_names}
@@ -524,7 +612,7 @@ class Executor:
         # collective audit's HLO re-lowering)
         self._last_feed_vals = feed_vals
         fetches, new_state = compiled.fn(feed_vals, state_vals, step)
-        scope.set(STEP_VAR, step + 1)
+        scope.set(STEP_VAR, step + iterations)
         for n, v in new_state.items():
             scope.set(n, v)
 
@@ -560,3 +648,4 @@ class Executor:
         self._deferred_flags = []
         self._cache.clear()
         self._probe_cache.clear()
+        self._stateful_cache.clear()

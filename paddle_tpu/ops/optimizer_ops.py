@@ -64,8 +64,11 @@ def _adam(ctx):
     ctx.set_output("ParamOut", p_out)
     ctx.set_output("Moment1Out", m1_out)
     ctx.set_output("Moment2Out", m2_out)
-    ctx.set_output("Beta1PowOut", b1p * b1)
-    ctx.set_output("Beta2PowOut", b2p * b2)
+    # preserve the accumulator's [1] shape: state written must match
+    # state read or the var can't chain through a scan carry
+    # (Executor.run(iterations=K))
+    ctx.set_output("Beta1PowOut", ctx.input("Beta1Pow") * b1)
+    ctx.set_output("Beta2PowOut", ctx.input("Beta2Pow") * b2)
 
 
 @register_op("adagrad", no_grad_slots=["Param", "Grad", "Moment",

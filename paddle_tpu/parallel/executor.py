@@ -128,7 +128,14 @@ class ParallelExecutor(Executor):
             mesh, self.sharding.feed_spec(name, arr.ndim)))
 
     def _compile(self, program, block, feed_sig, fetch_names, scope,
-                 while_bounds=None):
+                 while_bounds=None, iterations: int = 1,
+                 or_reduce_tail: int = 0):
+        if iterations != 1:
+            raise NotImplementedError(
+                "ParallelExecutor does not support run(iterations=K) yet "
+                "— the sharded state-threading path would need the scan "
+                "carry to preserve NamedShardings. Run steps one at a "
+                "time.")
         read_names, write_names = \
             self._state_names(program, block, scope)
         mesh = self.mesh

@@ -23,7 +23,10 @@ import numpy as np
 __all__ = [
     "map_readers", "shuffle", "chain", "compose", "buffered", "firstn",
     "xmap_readers", "batch", "double_buffer", "cache", "ComposeNotAligned",
+    "multiprocess_batch_reader",
 ]
+
+from .multiprocess import multiprocess_batch_reader  # noqa: E402
 
 
 class ComposeNotAligned(ValueError):

@@ -5,7 +5,7 @@ from __future__ import annotations
 
 from ..param_attr import ParamAttr
 from ..initializer import NormalInitializer, UniformInitializer
-from ..regularizer import L2DecayRegularizer
+from ..regularizer import L1DecayRegularizer, L2DecayRegularizer
 
 
 class ParameterAttribute:
@@ -20,6 +20,7 @@ class ParameterAttribute:
         self.initial_mean = initial_mean
         self.initial_max = initial_max
         self.initial_min = initial_min
+        self.l1_rate = l1_rate
         self.l2_rate = l2_rate
         self.learning_rate = learning_rate
         self.sparse_update = sparse_update
@@ -37,7 +38,13 @@ class ParameterAttribute:
                                or self.initial_min is not None):
             init = UniformInitializer(low=self.initial_min or -1.0,
                                       high=self.initial_max or 1.0)
-        reg = (L2DecayRegularizer(self.l2_rate)
+        if self.l1_rate and self.l2_rate:
+            raise NotImplementedError(
+                "simultaneous l1_rate and l2_rate on one parameter is "
+                "not supported — ParamAttr carries one regularizer; "
+                "pick one (the reference applies both)")
+        reg = (L1DecayRegularizer(self.l1_rate) if self.l1_rate
+               else L2DecayRegularizer(self.l2_rate)
                if self.l2_rate else None)
         return ParamAttr(name=self.name, initializer=init,
                          learning_rate=self.learning_rate,

@@ -8,7 +8,7 @@ from typing import Optional
 import numpy as np
 
 from .parameters import Parameters
-from .topology import Topology
+from .topology import Topology, build_feeder, sync_startup_state
 
 
 class Inference:
@@ -21,25 +21,11 @@ class Inference:
             self._topology.programs(is_test=True)
         # materialize any non-parameter persistables (e.g. BN stats)
         # the forward graph needs but the tar didn't carry
-        from ..core.scope import Scope
-        tmp = Scope()
-        pt.Executor().run(startup, scope=tmp)
-        for name in list(tmp.local_names()):
-            if not parameters.scope.has(name):
-                parameters.scope.set(name, tmp.get(name))
+        sync_startup_state(parameters.scope, startup)
         self._exe = pt.Executor()
 
     def _feeder(self, feeding: Optional[dict]):
-        from ..data_feeder import DataFeeder
-        data_layers = self._topology.data_layers()
-        if feeding:
-            by_index = sorted(
-                (idx, name) for name, idx in feeding.items())
-            order = {d.name: d for d in data_layers}
-            data_layers = [order[n] for _i, n in by_index
-                           if n in order]
-        block = self._main.global_block()
-        return DataFeeder([block.var(d.name) for d in data_layers])
+        return build_feeder(self._topology, self._main, feeding)
 
     def infer(self, input, feeding=None) -> np.ndarray:
         feeder = self._feeder(feeding)
@@ -60,10 +46,17 @@ class Inference:
 
 def _batches(input):
     """v2 infer() takes the WHOLE input as a list of samples; run it as
-    one batch (callers wanting batching pass an iterable of lists)."""
+    one batch (callers wanting batching pass an iterable of lists).
+    len() instead of truthiness: bool(ndarray) raises for >1 element."""
     if callable(input):
         yield from input()
-    elif input and isinstance(input[0], (list, tuple)) and input[0] and \
+    elif isinstance(input, np.ndarray):
+        # a 2-D array is a batch of dense rows; wrap each row as a
+        # one-slot sample tuple (bool(ndarray) raises, so arrays never
+        # reach the list-shaped checks below)
+        yield [(row,) for row in input]
+    elif len(input) and isinstance(input[0], (list, tuple)) \
+            and len(input[0]) and \
             isinstance(input[0][0], (list, tuple, np.ndarray, float, int)):
         yield input
     else:

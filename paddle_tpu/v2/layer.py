@@ -130,6 +130,12 @@ def fc(input, size, act=None, name=None, param_attr=None,
     def build(ctx):
         attrs = param_attr if isinstance(param_attr, (list, tuple)) \
             else [param_attr] * len(inputs)
+        if len(attrs) != len(inputs):
+            # zip truncation would silently drop surplus inputs; the
+            # reference config parser rejects the length mismatch
+            raise ValueError(
+                f"fc layer {node.name!r}: param_attr list has "
+                f"{len(attrs)} entries for {len(inputs)} inputs")
         parts = []
         for i, (inp, pa) in enumerate(zip(inputs, attrs)):
             parts.append(F.fc(
@@ -263,7 +269,9 @@ def img_cmrnorm(input, size, scale=0.0128, power=0.75, name=None,
     def build(ctx):
         var, shape = _image_of(inp, inp.to_var(ctx), num_channels)
         node.img_shape = shape
-        return F.lrn(var, n=size, alpha=scale, beta=power)
+        # reference config_parser.py:1360: norm_conf.scale /= norm.size
+        # for cmrnorm-projection — lrn's alpha is the per-element scale
+        return F.lrn(var, n=size, alpha=scale / size, beta=power)
 
     node._build = build
     return node
@@ -308,10 +316,28 @@ def spp(input, pyramid_height, num_channels=None, pool_type=None,
         outs = []
         for lvl in range(pyramid_height):
             bins = 2 ** lvl
-            ks = (math.ceil(h / bins), math.ceil(w / bins))
-            st = (math.ceil(h / bins), math.ceil(w / bins))
-            p = F.pool2d(var, pool_size=ks, pool_type=ptype,
-                         pool_stride=st)
+            kh, kw = math.ceil(h / bins), math.ceil(w / bins)
+            # the reference guarantees a bins x bins grid via ceil-mode
+            # pooling; floor-mode pool2d under-produces whenever h or w
+            # is not divisible by bins, so pad bottom/right up to
+            # kh*bins x kw*bins (-inf identity for max; zeros plus a
+            # coverage correction for avg)
+            ph, pw = kh * bins - h, kw * bins - w
+            src = var
+            if ph or pw:
+                src = F.pad2d(var, paddings=(0, ph, 0, pw),
+                              pad_value=-1e30 if ptype == "max" else 0.0)
+            p = F.pool2d(src, pool_size=(kh, kw), pool_type=ptype,
+                         pool_stride=(kh, kw))
+            if ptype != "max" and (ph or pw):
+                # zero-padded avg = sum/(kh*kw); dividing by the
+                # window coverage fraction restores the true mean
+                ones = F.fill_constant([1, 1, h, w], "float32", 1.0)
+                cnt = F.pool2d(
+                    F.pad2d(ones, paddings=(0, ph, 0, pw)),
+                    pool_size=(kh, kw), pool_type="avg",
+                    pool_stride=(kh, kw))
+                p = F.elementwise_div(p, cnt)
             outs.append(F.reshape(p, [-1, c * bins * bins]))
         return F.concat(outs, axis=1)
 
@@ -508,24 +534,14 @@ regression_cost = square_error_cost
 
 def parse_network(*outputs):
     """Lower the graphs reachable from `outputs` into a throwaway
-    Program and return a ModelConfig-shaped summary dict (layers,
-    parameters, input/output layer names)."""
-    import paddle_tpu as pt
+    Program and return a ModelConfig-shaped summary dict — exactly
+    Topology.proto(), which owns the summary shape."""
     from .topology import Topology
 
     outs = []
     for o in outputs:
         outs.extend(_listify(o))
-    topo = Topology(outs)
-    main, _startup, _fetches = topo.programs()
-    return {
-        "layers": [{"name": n.name, "type": n.type}
-                   for n in topo.nodes()],
-        "parameters": [{"name": p.name, "shape": list(p.shape)}
-                       for p in main.all_parameters()],
-        "input_layer_names": [d.name for d in topo.data_layers()],
-        "output_layer_names": [o.name for o in outs],
-    }
+    return Topology(outs).proto()
 
 
 __all__ = [

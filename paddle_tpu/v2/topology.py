@@ -104,3 +104,33 @@ class Topology:
         ModelConfig; the PTIR+params inference artifact itself comes
         from io.save_inference_model on the lowered program)."""
         stream.write(json.dumps(self.proto()).encode())
+
+
+def sync_startup_state(scope, startup) -> None:
+    """Run `startup` into a scratch scope and copy every name the
+    target scope lacks (optimizer accumulators, BN stats) — without
+    clobbering values the user already holds (reference:
+    Parameters.append_gradient_machine copies user arrays INTO the
+    machine). Shared by trainer.SGD and inference.Inference."""
+    import paddle_tpu as pt
+    from ..core.scope import Scope
+
+    tmp = Scope()
+    pt.Executor().run(startup, scope=tmp)
+    for name in list(tmp.local_names()):
+        if not scope.has(name):
+            scope.set(name, tmp.get(name))
+
+
+def build_feeder(topology: Topology, main_program, feeding=None):
+    """DataFeeder over the topology's data layers, reordered by the v2
+    `feeding` dict ({name: sample_index}) when given."""
+    from ..data_feeder import DataFeeder
+
+    data_layers = topology.data_layers()
+    if feeding:
+        by_index = sorted((idx, name) for name, idx in feeding.items())
+        order = {d.name: d for d in data_layers}
+        data_layers = [order[n] for _i, n in by_index if n in order]
+    block = main_program.global_block()
+    return DataFeeder([block.var(d.name) for d in data_layers])

@@ -16,7 +16,7 @@ from . import event as v2_event
 from . import optimizer as v2_optimizer
 from . import parameters as v2_parameters
 from .data_type import DataType, SequenceType
-from .topology import Topology
+from .topology import Topology, build_feeder, sync_startup_state
 
 
 class SGD:
@@ -44,12 +44,7 @@ class SGD:
         self._main, startup, self._fetches = \
             self.__topology__.programs(optimizer=update_equation)
         parameters.adopt(self._main)
-        from ..core.scope import Scope
-        tmp = Scope()
-        pt.Executor().run(startup, scope=tmp)
-        for name in list(tmp.local_names()):
-            if not self._scope.has(name):
-                self._scope.set(name, tmp.get(name))
+        sync_startup_state(self._scope, startup)
         self._exe = pt.Executor()
         # fetch the LOWERED var (node names are v2-graph names; the
         # fluid vars carry their own auto names)
@@ -58,18 +53,7 @@ class SGD:
 
     # -- feeding ------------------------------------------------------
     def _feeder(self, feeding: Optional[dict]):
-        from ..data_feeder import DataFeeder
-
-        data_layers = self.__topology__.data_layers()
-        if feeding:
-            by_index = sorted(
-                (idx, name) for name, idx in feeding.items())
-            names = [n for _i, n in by_index]
-            order = {d.name: d for d in data_layers}
-            data_layers = [order[n] for n in names if n in order]
-        main_block = self._main.global_block()
-        feed_vars = [main_block.var(d.name) for d in data_layers]
-        return DataFeeder(feed_vars)
+        return build_feeder(self.__topology__, self._main, feeding)
 
     # -- the event loop (reference trainer.py:137) --------------------
     def train(self, reader, num_passes=1, event_handler=None,

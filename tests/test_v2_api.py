@@ -290,3 +290,84 @@ def test_v2_sequence_conv_pool_uses_context_window():
         event_handler=lambda e: costs.append(e.cost) if isinstance(
             e, paddle.event.EndIteration) else None)
     assert np.mean(costs[-4:]) < 0.5 * np.mean(costs[:4]), costs
+
+
+def test_v2_cmrnorm_alpha_is_scale_over_size():
+    """reference config_parser.py:1360: cmrnorm-projection divides the
+    user's scale by size before it becomes lrn's alpha."""
+    from paddle_tpu.v2 import topology as v2_topology
+
+    pixel = layer.data(name="pix_cmr",
+                       type=data_type.dense_vector(3 * 8 * 8),
+                       height=8, width=8)
+    norm = layer.img_cmrnorm(input=pixel, size=5, scale=0.0128,
+                             num_channels=3)
+    main, _s, _f = v2_topology.Topology(norm).programs(is_test=True)
+    lrn_ops = [op for op in main.global_block().ops if op.type == "lrn"]
+    assert len(lrn_ops) == 1
+    assert abs(lrn_ops[0].attrs["alpha"] - 0.0128 / 5) < 1e-9
+
+
+def test_v2_spp_odd_size_gives_full_pyramid():
+    """7x7 input, pyramid_height=2: floor-mode pooling would produce a
+    1x1 grid at level 1; reference SPP guarantees bins x bins."""
+    import paddle_tpu as pt
+    from paddle_tpu.v2 import topology as v2_topology
+
+    pixel = layer.data(name="pix_spp",
+                       type=data_type.dense_vector(2 * 7 * 7),
+                       height=7, width=7)
+    spp = layer.spp(input=pixel, pyramid_height=2, num_channels=2)
+    main, startup, fetches = v2_topology.Topology(spp).programs(
+        is_test=True)
+    exe = pt.Executor()
+    sc = pt.core.scope.Scope()
+    exe.run(startup, scope=sc)
+    x = np.arange(2 * 49, dtype=np.float32).reshape(1, -1)
+    (out,) = exe.run(main, feed={"pix_spp": x},
+                     fetch_list=[fetches[spp.name]], scope=sc)
+    # level0: 1x1, level1: 2x2 -> 2*(1+4) = 10 features
+    assert out.shape == (1, 10)
+    img = x.reshape(2, 7, 7)
+    # level-0 max over the whole map, level-1 quadrant maxes (ceil
+    # windows: rows/cols split 4+3)
+    np.testing.assert_allclose(out[0, :2], img.max(axis=(1, 2)))
+    q = [img[:, :4, :4].max(axis=(1, 2)), img[:, :4, 4:].max(axis=(1, 2)),
+         img[:, 4:, :4].max(axis=(1, 2)), img[:, 4:, 4:].max(axis=(1, 2))]
+    expected = np.stack(q, axis=1).reshape(-1)
+    np.testing.assert_allclose(out[0, 2:], expected)
+
+
+def test_v2_fc_param_attr_length_mismatch_raises():
+    from paddle_tpu.v2 import attr as v2_attr
+    from paddle_tpu.v2 import topology as v2_topology
+
+    a = layer.data(name="fc_in_a", type=data_type.dense_vector(4))
+    b = layer.data(name="fc_in_b", type=data_type.dense_vector(4))
+    out = layer.fc(input=[a, b], size=3,
+                   param_attr=[v2_attr.Param(initial_std=0.1)])
+    with pytest.raises(ValueError, match="param_attr"):
+        v2_topology.Topology(out).programs()
+
+
+def test_v2_param_attr_l1_rate_wired():
+    from paddle_tpu.v2 import attr as v2_attr
+    from paddle_tpu.regularizer import L1DecayRegularizer
+
+    pa = v2_attr.Param(l1_rate=0.01).to_param_attr()
+    assert isinstance(pa.regularizer, L1DecayRegularizer)
+    with pytest.raises(NotImplementedError):
+        v2_attr.Param(l1_rate=0.01, l2_rate=0.1).to_param_attr()
+
+
+def test_v2_infer_accepts_ndarray_input():
+    from paddle_tpu import v2 as pv2
+
+    x = layer.data(name="nd_in", type=data_type.dense_vector(6))
+    out = layer.fc(input=x, size=2,
+                   act=__import__("paddle_tpu.v2.activation",
+                                  fromlist=["Softmax"]).Softmax())
+    params = pv2.parameters.create(out)
+    probs = pv2.infer(output_layer=out, parameters=params,
+                      input=np.ones((3, 6), np.float32))
+    assert probs.shape == (3, 2)
