@@ -53,3 +53,44 @@ def run_benchmark(exe, program, feed, loss_var, args, unit_per_step,
     print(f"throughput: {per_sec:,.1f} {unit}/sec "
           f"({1.0 / steps_per_sec * 1e3:.1f} ms/batch)")
     return per_sec
+
+
+def time_chain(fn, x0, flops_per_call, label, n1=10, n2=110,
+               repeats=3, peak_flops=197e12):
+    """Kernel-A/B marginal timing: jit with donated self-chained arg
+    (the tunnel only fast-paths executes whose argument buffers it has
+    seen), 3 warmups + a synced throwaway, then median of `repeats`
+    marginal deltas t(n2)-t(n1). Shared by the kernel A/B harnesses so
+    protocol fixes land once."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    jitted = jax.jit(fn, donate_argnums=(0,))
+    x = jnp.copy(x0)
+
+    def run_n(x, n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            x = jitted(x)
+        s = float(np.asarray(jnp.sum(
+            jnp.ravel(x)[:1].astype(jnp.float32))))
+        assert np.isfinite(s), label
+        return x, time.perf_counter() - t0
+
+    for _ in range(3):
+        x = jitted(x)
+    x, _ = run_n(x, 1)
+    ests = []
+    for _ in range(repeats):
+        x, t1 = run_n(x, n1)
+        x, t2 = run_n(x, n2)
+        ests.append((t2 - t1) / (n2 - n1))
+    dt = float(np.median(ests))
+    spread = (max(ests) - min(ests)) / dt
+    tflops = flops_per_call / dt / 1e12
+    print(f"{label:26s} {dt * 1e3:8.2f} ms/call  {tflops:6.1f} TFLOP/s"
+          f" ({100 * tflops * 1e12 / peak_flops:4.1f}% of peak)  "
+          f"spread {100 * spread:.0f}%", flush=True)
+    return dt

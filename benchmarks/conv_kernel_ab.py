@@ -182,33 +182,9 @@ def run_bottleneck(name, bs, big_c, small_c, side, rng, l_blocks=8):
 
 
 def time_chain(fn, x0, flops_per_call, label):
-    """Donated-arg self-chain + marginal timing."""
-    jitted = jax.jit(fn, donate_argnums=(0,))
-    x = jnp.copy(x0)   # x0 stays live for the other chains
-
-    def run_n(x, n):
-        t0 = time.perf_counter()
-        for _ in range(n):
-            x = jitted(x)
-        s = float(np.asarray(jnp.sum(x[:1, :1].astype(jnp.float32))))
-        assert np.isfinite(s), label
-        return x, time.perf_counter() - t0
-
-    for _ in range(3):
-        x = jitted(x)
-    x, _ = run_n(x, 1)
-    ests = []
-    for _ in range(3):
-        x, t1 = run_n(x, N1)
-        x, t2 = run_n(x, N2)
-        ests.append((t2 - t1) / (N2 - N1))
-    dt = float(np.median(ests))
-    spread = (max(ests) - min(ests)) / dt
-    tflops = flops_per_call / dt / 1e12
-    print(f"{label:28s} {dt * 1e3:8.2f} ms/call  {tflops:6.1f} TFLOP/s "
-          f"({100 * tflops / 197:4.1f}% of peak)  spread "
-          f"{100 * spread:.0f}%")
-    return dt
+    """Donated-arg self-chain + marginal timing (shared protocol)."""
+    from common import time_chain as shared
+    return shared(fn, x0, flops_per_call, label, n1=N1, n2=N2)
 
 
 def main():

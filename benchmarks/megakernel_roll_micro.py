@@ -1,0 +1,179 @@
+"""Microbench: where does the stage-2 megakernel's non-MXU time go?
+
+Variants of the bottleneck kernel at stage-2 shapes, all with the same
+dot structure and HBM footprint:
+  full      : the real kernel (rolls + masks + ghost BN)
+  noroll    : taps use h1 unshifted, no mask (WRONG math, same flops)
+            -> isolates the cost of rolls+masks
+  strided   : dy-trio built with ONE strided roll on a [3, M, Cm]
+            stack instead of three plain rolls
+  nobn      : rolls+masks kept, ghost-BN stats removed (affine only)
+            -> isolates the stats-reduction cost
+
+Run on TPU: python benchmarks/megakernel_roll_micro.py
+"""
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+EPS = 1e-5
+L = 8
+N1, N2 = 10, 110
+BS, CIN, CM, SIDE, TILE = 128, 512, 128, 28, 2
+
+
+def _coefs(h, p_ref):
+    m = h.shape[0]
+    mean = jnp.sum(h, axis=0, keepdims=True) / m
+    var = jnp.sum(h * h, axis=0, keepdims=True) / m - mean * mean
+    a = p_ref[0:1, :] * jax.lax.rsqrt(var + EPS)
+    return a, p_ref[1:2, :] - mean * a
+
+
+def _kernel(x_ref, w1_ref, w3_ref, w2_ref, p1_ref, p2_ref, p3_ref,
+            out_ref, *, variant):
+    hw = SIDE * SIDE
+    m = TILE * hw
+    x = x_ref[:]
+    cm = CM
+    dt = x_ref.dtype
+
+    acc1 = jnp.dot(x, w1_ref[:], preferred_element_type=jnp.float32)
+    if variant == "nobn":
+        a1 = p1_ref[0:1, :]
+        b1 = p1_ref[1:2, :]
+    else:
+        a1, b1 = _coefs(acc1, p1_ref)
+    a1t = jnp.concatenate([a1] * 3, axis=1)
+    b1t = jnp.concatenate([b1] * 3, axis=1)
+
+    row = jax.lax.broadcasted_iota(jnp.int32, (m, 1), 0)
+    p_local = row % hw
+    hh = p_local // SIDE
+    ww = p_local % SIDE
+    w_ok = [ww - 1 >= 0, row >= 0, ww + 1 < SIDE]
+
+    acc2 = jnp.zeros((m, cm), jnp.float32)
+    for dy in (-1, 0, 1):
+        if variant == "noroll":
+            trio = jnp.concatenate([acc1] * 3, axis=1)
+            tap = jnp.maximum(trio * a1t + b1t, 0.0)
+        elif variant == "strided":
+            stack = jnp.stack([acc1] * 3)              # [3, M, Cm]
+            # slice j gets shift base+j: j=0 -> -dy*S-1 (the dx=+1
+            # tap), j=2 -> -dy*S+1 (dx=-1); reverse the concat so the
+            # trio lines up with w3's (dx=-1,0,+1) order and the masks
+            shifted = pltpu.roll(stack, (-dy * SIDE - 1) % m, 1,
+                                 stride=1, stride_axis=0)
+            trio = jnp.concatenate(
+                [shifted[2], shifted[1], shifted[0]], axis=1)
+            h_ok = (hh + dy >= 0) & (hh + dy < SIDE)
+            mask = jnp.concatenate(
+                [jnp.broadcast_to(h_ok & wk, (m, cm)) for wk in w_ok],
+                axis=1)
+            tap = jnp.where(mask,
+                            jnp.maximum(trio * a1t + b1t, 0.0), 0.0)
+        else:
+            base = pltpu.roll(acc1, (-dy * SIDE) % m, 0) if dy else acc1
+            trio = jnp.concatenate(
+                [base if dx == 0 else pltpu.roll(base, (-dx) % m, 0)
+                 for dx in (-1, 0, 1)], axis=1)
+            h_ok = (hh + dy >= 0) & (hh + dy < SIDE)
+            mask = jnp.concatenate(
+                [jnp.broadcast_to(h_ok & wk, (m, cm)) for wk in w_ok],
+                axis=1)
+            tap = jnp.where(mask,
+                            jnp.maximum(trio * a1t + b1t, 0.0), 0.0)
+        wt = w3_ref[(dy + 1) * 3:(dy + 1) * 3 + 3].reshape(3 * cm, cm)
+        acc2 = acc2 + jnp.dot(tap.astype(dt), wt,
+                              preferred_element_type=jnp.float32)
+
+    if variant == "nobn":
+        a2, b2 = p2_ref[0:1, :], p2_ref[1:2, :]
+    else:
+        a2, b2 = _coefs(acc2, p2_ref)
+    h2 = jnp.maximum(acc2 * a2 + b2, 0.0).astype(dt)
+    acc3 = jnp.dot(h2, w2_ref[:], preferred_element_type=jnp.float32)
+    if variant == "nobn":
+        a3, b3 = p3_ref[0:1, :], p3_ref[1:2, :]
+    else:
+        a3, b3 = _coefs(acc3, p3_ref)
+    y = acc3 * a3 + b3 + x.astype(jnp.float32)
+    out_ref[:] = jnp.maximum(y, 0.0).astype(out_ref.dtype)
+
+
+def block(x, w1, w3, w2, p1, p2, p3, variant):
+    hw = SIDE * SIDE
+    m = TILE * hw
+    n = x.shape[0] // hw
+    return pl.pallas_call(
+        functools.partial(_kernel, variant=variant),
+        grid=(n // TILE,),
+        in_specs=[
+            pl.BlockSpec((m, CIN), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((CIN, CM), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((9, CM, CM), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((CM, CIN), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, CM), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, CM), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, CIN), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((m, CIN), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x, w1, w3, w2, p1, p2, p3)
+
+
+def chain(x, params, variant):
+    for (w1, w3, w2, p1, p2, p3) in params:
+        x = block(x, w1, w3, w2, p1, p2, p3, variant)
+    return x
+
+
+def time_chain(fn, x0, flops, label):
+    from common import time_chain as shared
+    return shared(fn, x0, flops, label, n1=N1, n2=N2)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    hw = SIDE * SIDE
+    params = []
+    for _ in range(L):
+        params.append((
+            jnp.asarray(rng.randn(CIN, CM) / np.sqrt(CIN), jnp.bfloat16),
+            jnp.asarray(rng.randn(9, CM, CM) / np.sqrt(9 * CM),
+                        jnp.bfloat16),
+            jnp.asarray(rng.randn(CM, CIN) / np.sqrt(CM), jnp.bfloat16),
+            jnp.stack([jnp.ones(CM), jnp.zeros(CM)]).astype(jnp.float32),
+            jnp.stack([jnp.ones(CM), jnp.zeros(CM)]).astype(jnp.float32),
+            jnp.stack([jnp.ones(CIN), jnp.zeros(CIN)]).astype(
+                jnp.float32),
+        ))
+    x = jnp.asarray(rng.randn(BS * hw, CIN) * 0.5, jnp.bfloat16)
+    flops = L * 2.0 * BS * hw * CM * (CIN + 9 * CM + CIN)
+    for variant in ("full", "strided", "nobn", "noroll"):
+        try:
+            time_chain(functools.partial(chain, params=params,
+                                         variant=variant), x, flops,
+                       variant)
+        except Exception as e:
+            print(f"{variant}: FAILED {repr(e)[:180]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
