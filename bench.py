@@ -441,12 +441,14 @@ def bench_mnist(pt):
     """MNIST conv training (BASELINE config 1; tests/book
     recognize_digits)."""
     from paddle_tpu.models import mnist
-    # ~2ms steps: even 360-step windows posted 66% spread (BENCH_r03) —
-    # per-dispatch tunnel jitter is the same order as the window. 64
-    # steps per compiled dispatch (lax.scan) amortizes it away.
+    # ~0.3ms steps: even 360-step windows posted 66% spread (BENCH_r03)
+    # — per-dispatch tunnel jitter is the same order as the window.
+    # Steps compiled into one dispatch (lax.scan) amortize it away; at
+    # K=64 the chip-validated spread was 9.3% (calls still only ~20ms),
+    # K=256 puts each call at ~80ms for real margin.
     return _bench_image_model(
         pt, mnist.build_train, 512, (1, 28, 28), 10,
-        n1=5, n2=25, repeats=3, iterations=64)
+        n1=5, n2=25, repeats=3, iterations=256)
 
 
 def bench_deepfm(pt):
