@@ -21,6 +21,7 @@ from . import inference  # noqa: F401
 from . import layer  # noqa: F401
 from . import minibatch  # noqa: F401
 from . import networks  # noqa: F401
+from . import op  # noqa: F401  (registers Layer arithmetic operators)
 from . import optimizer  # noqa: F401
 from . import parameters  # noqa: F401
 from . import pooling  # noqa: F401
@@ -30,8 +31,12 @@ from . import trainer  # noqa: F401
 # data plumbing is shared with the modern API (one implementation)
 from .. import dataset  # noqa: F401
 from .. import reader  # noqa: F401
+from .. import data_feeder  # noqa: F401
 from ..dataset import image  # noqa: F401
 from ..debug import Ploter  # noqa: F401
+# the reference's v2 __all__ also re-exports the program getters
+from ..framework import default_main_program  # noqa: F401
+from ..framework import default_startup_program  # noqa: F401
 
 
 class _PlotModule:

@@ -1,0 +1,110 @@
+"""v2 layer arithmetic (reference: python/paddle/v2/op.py — unary math
+ops over layers plus +,-,* operator overloads on Layer).
+
+The reference builds these from mixed/identity_projection/
+slope_intercept config layers; here each lowers directly onto the one
+Program engine as the equivalent fluid op (scale/elementwise_*), same
+user-visible semantics: scalars fold into an affine, equal-size layers
+combine elementwise, and a size-1 layer broadcasts (the reference's
+repeat/scaling cases).
+"""
+from __future__ import annotations
+
+from .. import layers as F
+from .config_base import Layer
+
+__all__ = []
+
+
+def _unary(op_name, fn):
+    def op(input, name=None):
+        node = Layer(op_name, parents=[input], name=name,
+                     size=getattr(input, "size", 0))
+        node._build = lambda ctx: fn(input.to_var(ctx))
+        return node
+
+    op.__name__ = op_name
+    globals()[op_name] = op
+    __all__.append(op_name)
+
+
+_unary("exp", lambda v: F.exp(v))
+_unary("log", lambda v: F.log(v))
+_unary("abs", lambda v: F.abs(v))
+_unary("sigmoid", lambda v: F.sigmoid(v))
+_unary("tanh", lambda v: F.tanh(v))
+_unary("square", lambda v: F.square(v))
+_unary("relu", lambda v: F.relu(v))
+_unary("sqrt", lambda v: F.sqrt(v))
+_unary("reciprocal", lambda v: F.elementwise_div(
+    F.fill_constant([1], "float32", 1.0), v))
+_unary("softmax", lambda v: F.softmax(v))
+
+
+def _affine(input, slope=1.0, intercept=0.0):
+    node = Layer("slope_intercept", parents=[input],
+                 size=getattr(input, "size", 0))
+    node._build = lambda ctx: F.scale(input.to_var(ctx),
+                                      scale=float(slope),
+                                      bias=float(intercept))
+    return node
+
+
+def _binary(kind, a, b, fn):
+    node = Layer(kind, parents=[a, b],
+                 size=max(getattr(a, "size", 0), getattr(b, "size", 0)))
+    node._build = lambda ctx: fn(a.to_var(ctx), b.to_var(ctx))
+    return node
+
+
+def _add(self, other):
+    if isinstance(other, (int, float)):
+        return _affine(self, intercept=other)
+    if not isinstance(other, Layer):
+        raise TypeError("Layer can only be added with another Layer "
+                        "or a number")
+    if self.size and other.size and self.size != other.size and \
+            1 not in (self.size, other.size):
+        raise TypeError(
+            f"Two Layers can be added only if they have equal size or "
+            f"one of their sizes is 1; sizes are {self.size} and "
+            f"{other.size}")
+    return _binary("add", self, other, F.elementwise_add)
+
+
+def _neg(self):
+    return _affine(self, slope=-1.0)
+
+
+def _sub(self, other):
+    if isinstance(other, (int, float)):
+        return _affine(self, intercept=-other)
+    if not isinstance(other, Layer):
+        raise TypeError("Layer can only be subtracted with another "
+                        "Layer or a number")
+    return _add(self, _neg(other))
+
+
+def _rsub(self, other):
+    return _add(_neg(self), other)
+
+
+def _mul(self, other):
+    if isinstance(other, (int, float)):
+        return _affine(self, slope=other)
+    if not isinstance(other, Layer):
+        raise TypeError("Layer can only be multiplied with another "
+                        "Layer or a number")
+    if 1 not in (self.size, other.size):
+        raise TypeError("At least one of the operands of '*' must be "
+                        "a number or a Layer with size=1")
+    return _binary("scaling", self, other, F.elementwise_mul)
+
+
+Layer.__add__ = _add
+Layer.__radd__ = _add
+Layer.__neg__ = _neg
+Layer.__sub__ = _sub
+Layer.__rsub__ = _rsub
+Layer.__mul__ = _mul
+Layer.__rmul__ = _mul

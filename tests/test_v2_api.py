@@ -371,3 +371,40 @@ def test_v2_infer_accepts_ndarray_input():
     probs = pv2.infer(output_layer=out, parameters=params,
                       input=np.ones((3, 6), np.float32))
     assert probs.shape == (3, 2)
+
+
+def test_v2_surface_matches_reference_all():
+    """Every name in the reference v2/__init__.py __all__ resolves."""
+    from paddle_tpu import v2
+
+    ref_all = ['default_startup_program', 'default_main_program',
+               'optimizer', 'layer', 'activation', 'parameters', 'init',
+               'trainer', 'event', 'data_type', 'attr', 'pooling',
+               'dataset', 'reader', 'topology', 'networks', 'infer',
+               'plot', 'evaluator', 'image', 'master']
+    missing = [n for n in ref_all if not hasattr(v2, n)]
+    assert not missing, missing
+
+
+def test_v2_layer_arithmetic():
+    """reference v2/op.py: +,-,* overloads and unary math over layers."""
+    import paddle_tpu as pt
+    from paddle_tpu.v2 import op as v2_op
+    from paddle_tpu.v2 import topology as v2_topology
+
+    x = layer.data(name="arith_x", type=data_type.dense_vector(4))
+    y = layer.data(name="arith_y", type=data_type.dense_vector(4))
+    z = v2_op.tanh(x) + y * 2.0 - 1.0
+    main, startup, fetches = v2_topology.Topology(z).programs(
+        is_test=True)
+    exe = pt.Executor()
+    sc = pt.core.scope.Scope()
+    exe.run(startup, scope=sc)
+    xv = np.linspace(-1, 1, 8).reshape(2, 4).astype(np.float32)
+    yv = np.ones((2, 4), np.float32)
+    (out,) = exe.run(main, feed={"arith_x": xv, "arith_y": yv},
+                     fetch_list=[fetches[z.name]], scope=sc)
+    np.testing.assert_allclose(out, np.tanh(xv) + 2.0 * yv - 1.0,
+                               rtol=1e-5, atol=1e-5)
+    with pytest.raises(TypeError, match="size"):
+        _ = layer.fc(input=x, size=3) + layer.fc(input=x, size=5)
