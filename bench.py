@@ -49,6 +49,11 @@ BASELINE_GOOGLENET_IPS = 264.83
 # flattering by up to that factor; the MFU field is the calibrated
 # efficiency number.
 BASELINE_VGG_IPS = 28.46
+# ResNeXt-152 anchor: the ParallelExecutor design doc's single-GPU
+# number — 17.99 img/s, TitanX, bs12 (doc/design/parallel_executor.md:
+# 29-35). The bench matches that protocol (SE-ResNeXt-152 counts
+# (3,8,36,3), bs12).
+BASELINE_SE_RESNEXT_IPS = 17.99
 
 # MFU accounting (north star: >=50% MFU ResNet-50): v5e peak bf16
 # throughput per chip. ResNet-50 forward is ~4.1 GMAC/image at 224^2;
@@ -437,6 +442,19 @@ def bench_googlenet(pt):
         128, (3, 224, 224), 1000, n1=10, n2=60, repeats=3)
 
 
+def bench_se_resnext(pt):
+    """SE-ResNeXt-152 at the reference anchor's protocol (bs12 —
+    doc/design/parallel_executor.md). bs12 steps are ms-scale on TPU,
+    so K steps ride one compiled scan like the other small-step
+    extras."""
+    from paddle_tpu.models import resnet
+    return _bench_image_model(
+        pt, lambda: resnet.build_se_resnext_train(
+            class_dim=1000, image_shape=(3, 224, 224),
+            layers_counts=(3, 8, 36, 3), lr=0.1),
+        12, (3, 224, 224), 1000, n1=5, n2=25, repeats=3, iterations=16)
+
+
 def bench_mnist(pt):
     """MNIST conv training (BASELINE config 1; tests/book
     recognize_digits)."""
@@ -617,6 +635,13 @@ def main():
                     ips / BASELINE_GOOGLENET_IPS, 2),
                 "googlenet_spread_pct": round(100 * sp, 1)}
 
+    def x_se_resnext():
+        ips, sp = bench_se_resnext(pt)
+        return {"se_resnext152_images_per_sec": round(ips, 0),
+                "se_resnext152_vs_baseline": round(
+                    ips / BASELINE_SE_RESNEXT_IPS, 2),
+                "se_resnext152_spread_pct": round(100 * sp, 1)}
+
     def x_mnist():
         ips, sp = bench_mnist(pt)
         return {"mnist_images_per_sec": round(ips, 0),
@@ -665,6 +690,7 @@ def main():
         _run_extra(pt, extras, amp_on, x_vgg)
         _run_extra(pt, extras, amp_on, x_alexnet)
         _run_extra(pt, extras, amp_on, x_googlenet)
+        _run_extra(pt, extras, amp_on, x_se_resnext)
         _run_extra(pt, extras, amp_on, x_mnist)
         _run_extra(pt, extras, False, x_deepfm)
         _run_extra(pt, extras, amp_on, x_infer)
