@@ -390,13 +390,16 @@ def bench_resnet_real_input(pt):
     return e2e_ips, pipeline_ips
 
 
-def bench_transformer(pt):
+def bench_transformer(pt, b=32, ln=256):
     """Always-on extra (off via BENCH_TRANSFORMER=0): transformer-base
-    NMT train step (BASELINE.json config 4).
-    Measured on chip at ~111-115k tokens/s (bs32, len 256, 6 layers,
-    d512, 32k vocab, bf16, flash attention with 1024x1024 blocks)."""
+    NMT train step (BASELINE.json config 4) at b32 x s256.
+
+    The long-context arm calls this with b4 x s2048 (equal token
+    budget): above the measured S>=512 routing crossover the Pallas
+    flash-attention kernels carry the quadratic term — the single-chip
+    evidence for the long-context path (the multi-chip ring/Ulysses
+    continuation is exercised by dryrun_multichip's sp section)."""
     from paddle_tpu.models import transformer
-    b, ln = 32, 256
     main_p, startup, f = transformer.build_train(
         src_vocab=32000, trg_vocab=32000, max_len=ln, n_layer=6,
         n_head=8, d_model=512, d_inner=2048, lr=1e-3)
@@ -621,6 +624,11 @@ def main():
                     3),
                 "transformer_spread_pct": round(100 * sp, 1)}
 
+    def x_transformer_long():
+        t, sp = bench_transformer(pt, b=4, ln=2048)
+        return {"transformer_s2048_tokens_per_sec": round(t, 0),
+                "transformer_s2048_spread_pct": round(100 * sp, 1)}
+
     def x_lstm():
         # scan LSTM is latency-bound, not MXU-bound: bf16 casts around
         # the small recurrent matmuls only add overhead
@@ -708,6 +716,7 @@ def main():
 
     if os.environ.get("BENCH_TRANSFORMER", "1") == "1":
         _run_extra(pt, extras, amp_on, x_transformer)
+        _run_extra(pt, extras, amp_on, x_transformer_long)
     if RUN_EXTRAS:
         _run_extra(pt, extras, False, x_lstm)
         _run_extra(pt, extras, False, x_lstm_varlen)
