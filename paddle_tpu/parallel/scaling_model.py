@@ -124,6 +124,61 @@ def predict(inv, mesh_axis_sizes: Dict[str, int], t_comp: float) -> Dict:
 # the AOT compile alone costs ~40s).
 # ---------------------------------------------------------------------
 
+def predict_multihost(inv, mesh_axis_sizes: Dict[str, int],
+                      t_comp: float, hosts: int,
+                      dcn_axis: str = "data") -> Dict:
+    """Two-tier (ICI intra-host + DCN inter-host) prediction — the
+    multi-host continuation of `predict`, answering the question the
+    reference answered with its multi-host pserver tables
+    (benchmark/cluster/vgg16/README.md:96-130).
+
+    Layout convention (the standard one): model/seq axes live INSIDE a
+    host; only the `dcn_axis` (data parallelism) spans hosts. A
+    collective whose axis set includes `dcn_axis` decomposes
+    hierarchically — for all-reduce, the canonical 3 phases:
+    reduce-scatter over the intra-host group g (ICI), all-reduce of
+    each 1/g shard across H hosts (each chip's shard rides its own
+    host-NIC share, DCN), all-gather over g (ICI) — ICI bytes equal
+    the flat ring's, DCN moves 2*(B/g)*(H-1)/H per chip. Other kinds
+    are charged their full ring cost at BOTH tiers (shard bytes across
+    DCN) — conservative. Axes without `dcn_axis` stay pure ICI."""
+    per_axis: Dict[str, float] = {}
+    t_comm = t_dcn_total = 0.0
+    for (kind, axes), (count, b) in inv.items():
+        if axes in (("?",), ("local",)):
+            continue
+        n = int(np.prod([mesh_axis_sizes[a] for a in axes]))
+        if dcn_axis in axes and hosts > 1:
+            # the DATA axis is what spans hosts (layout convention
+            # above) — its size must divide into them, or the layout
+            # cannot exist and mis-pricing it would be silent
+            assert mesh_axis_sizes[dcn_axis] % hosts == 0, (
+                dcn_axis, mesh_axis_sizes[dcn_axis], hosts)
+            g = n // hosts
+            t_ici = _collective_time(kind, b, count, g)
+            t_dcn = _collective_time(kind, b // g, count, hosts,
+                                     bw=DCN_BW, lat=DCN_LAT)
+            t = t_ici + t_dcn
+            t_dcn_total += t_dcn
+        else:
+            t = _collective_time(kind, b, count, n)
+        t_comm += t
+        for a in axes:
+            per_axis[a] = per_axis.get(a, 0.0) + t
+    return {
+        "hosts": hosts,
+        "chips_per_host": int(np.prod(
+            list(mesh_axis_sizes.values()))) // hosts,
+        "t_comp_ms": round(t_comp * 1e3, 3),
+        "t_comm_ms": round(t_comm * 1e3, 3),
+        "t_dcn_ms": round(t_dcn_total * 1e3, 3),
+        "per_axis_ms": {a: round(t * 1e3, 3)
+                        for a, t in sorted(per_axis.items())},
+        "eff_serial": round(t_comp / (t_comp + t_comm), 4),
+        "eff_overlap": round(t_comp / max(t_comp, t_comm), 4),
+    }
+
+
 def aot_compiled_hlo(pexe, program, feed_structs: Dict, fetch_list,
                      scope=None) -> str:
     """Compiled HLO of `program` on pexe's mesh at the shapes/dtypes in
@@ -342,6 +397,12 @@ def scaling_report(n_list=(8, 16, 64), configs=("resnet50",
                 f"{kind} over {'+'.join(axes)}": [cnt, b]
                 for (kind, axes), (cnt, b) in sorted(
                     inv.items(), key=lambda kv: -kv[1][1])}
+            # multi-host view of the same compiled inventory: n chips
+            # as H hosts x n/H chips (v5e-8 hosts), data axis over DCN
+            hosts = {16: 2, 64: 8}.get(n)
+            if hosts and axis_sizes.get("data", 1) % hosts == 0:
+                pred["multihost"] = predict_multihost(
+                    inv, axis_sizes, _t_comp(cfg, axis_sizes), hosts)
             per_n[str(n)] = pred
         lo, hi = str(min(n_list)), str(max(n_list))
         per_n["eff_%s_to_%s" % (lo, hi)] = round(

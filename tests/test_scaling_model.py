@@ -57,3 +57,31 @@ def test_deepfm_audit_and_prediction_at_8_devices():
                                        num_features=int(4e5))
     b4 = ca.axis_bytes(ca.inventory(hlo4, mesh4))["model"]
     assert b1 == b4, (b1, b4)
+
+
+def test_predict_multihost_decomposition():
+    """Hierarchical all-reduce math: ICI bytes equal the flat ring's;
+    DCN tier moves 2*(B/g)*(H-1)/H per chip at DCN constants; pure
+    intra-host axes are untouched."""
+    from paddle_tpu.parallel import scaling_model as sm
+
+    B = 512 * 1024 * 1024
+    inv = {("all-reduce", ("data",)): (1, B),
+           ("all-gather", ("model",)): (2, B // 16)}
+    axis = {"data": 16, "model": 4}
+    t_comp = 0.050
+    flat = sm.predict(inv, axis, t_comp)
+    mh = sm.predict_multihost(inv, axis, t_comp, hosts=2)
+    assert mh["hosts"] == 2 and mh["chips_per_host"] == 32
+    # DCN component: 2*(B/g)*(H-1)/H / DCN_BW (+2*(H-1) hops), where
+    # g = n/hosts is the intra-host group of the data-axis collective
+    n = 16
+    g = n // 2
+    t_dcn_expect = (2 * (B // g) * (2 - 1) / 2 / sm.DCN_BW
+                    + 1 * 2 * (2 - 1) * sm.DCN_LAT)
+    assert abs(mh["t_dcn_ms"] - t_dcn_expect * 1e3) < 1e-3, (
+        mh["t_dcn_ms"], t_dcn_expect * 1e3)
+    # multi-host comm >= flat-ICI comm (DCN is slower), and the
+    # model-axis (intra-host) share is identical in both
+    assert mh["t_comm_ms"] >= flat["t_comm_ms"]
+    assert mh["per_axis_ms"]["model"] == flat["per_axis_ms"]["model"]
