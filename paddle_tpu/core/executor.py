@@ -574,6 +574,11 @@ class Executor:
         while_bounds = self._probe_while_bounds(
             program, block, feed_vals, feed_sig, scope, block_idx, step)
 
+        if iterations < 1:
+            raise ValueError(
+                f"iterations must be >= 1, got {iterations}: a "
+                "zero-length scan would return zero-initialized "
+                "fetches without running anything")
         if iterations > 1:
             if while_bounds:
                 raise RuntimeError(

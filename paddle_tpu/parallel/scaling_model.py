@@ -95,24 +95,10 @@ def predict(inv, mesh_axis_sizes: Dict[str, int], t_comp: float) -> Dict:
     mesh_axis_sizes: {axis_name: size}
     t_comp: measured-anchor single-chip compute seconds per step
     """
-    per_axis: Dict[str, float] = {}
-    t_comm = 0.0
-    for (kind, axes), (count, b) in inv.items():
-        if axes in (("?",), ("local",)):
-            continue
-        n = int(np.prod([mesh_axis_sizes[a] for a in axes]))
-        t = _collective_time(kind, b, count, n)
-        t_comm += t
-        for a in axes:
-            per_axis[a] = per_axis.get(a, 0.0) + t
-    return {
-        "t_comp_ms": round(t_comp * 1e3, 3),
-        "t_comm_ms": round(t_comm * 1e3, 3),
-        "per_axis_ms": {a: round(t * 1e3, 3)
-                        for a, t in sorted(per_axis.items())},
-        "eff_serial": round(t_comp / (t_comp + t_comm), 4),
-        "eff_overlap": round(t_comp / max(t_comp, t_comm), 4),
-    }
+    out = predict_multihost(inv, mesh_axis_sizes, t_comp, hosts=1)
+    for k in ("hosts", "chips_per_host", "t_dcn_ms"):
+        out.pop(k)
+    return out
 
 
 # ---------------------------------------------------------------------

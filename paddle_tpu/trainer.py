@@ -103,13 +103,26 @@ class Trainer:
 
     # -- training loop ----------------------------------------------------
     def train(self, num_passes: int, reader: Callable[[], Iterable],
-              event_handler: Optional[Callable] = None):
+              event_handler: Optional[Callable] = None,
+              steps_per_dispatch: int = 1):
+        """Event-loop training. steps_per_dispatch > 1 runs that many
+        steps on the SAME batch inside one compiled dispatch
+        (Executor.run(iterations=K) — a lax.scan over the step): on a
+        high-RTT link, per-dispatch overhead is paid once per K steps.
+        Semantics trade-off, stated: each reader batch is consumed K
+        times, events fire once per DISPATCH (with the final
+        iteration's cost/metrics), and self.step advances by K."""
         if not self._started:
             self.start()
         handler = event_handler or (lambda e: None)
         fetch_names = list(self.fetch_metrics)
         fetch_list = [self.loss] + [self.fetch_metrics[k]
                                     for k in fetch_names]
+        k = int(steps_per_dispatch)
+        if k < 1:
+            raise ValueError(
+                f"steps_per_dispatch must be >= 1, got {k} — a zero "
+                "dispatch would report cost 0.0 while training nothing")
         for pass_id in range(num_passes):
             handler(BeginPass(pass_id))
             costs = []
@@ -117,20 +130,25 @@ class Trainer:
                 handler(BeginIteration(pass_id, batch_id))
                 feed = self._to_feed(batch)
                 outs = self.exe.run(self.main_program, feed=feed,
-                                    fetch_list=fetch_list)
+                                    fetch_list=fetch_list,
+                                    iterations=k)
                 cost = float(np.asarray(_dense(outs[0])).reshape(-1)[0])
-                metrics = {k: _dense(v) for k, v in
+                metrics = {k_: _dense(v) for k_, v in
                            zip(fetch_names, outs[1:])}
                 costs.append(cost)
-                self.step += 1
+                self.step += k
                 handler(EndIteration(pass_id, batch_id, cost, metrics))
-                self._maybe_checkpoint()
+                self._maybe_checkpoint(advanced=k)
             handler(EndPass(pass_id, {
                 "mean_cost": float(np.mean(costs)) if costs else None}))
 
-    def _maybe_checkpoint(self):
+    def _maybe_checkpoint(self, advanced: int = 1):
         cc = self.checkpoint_config
-        if cc and self.step % cc.every_n_batches == 0:
+        # "crossed a multiple" rather than "== 0": with
+        # steps_per_dispatch > 1 the counter advances in strides and
+        # may never land exactly on a multiple
+        if cc and (self.step // cc.every_n_batches
+                   > (self.step - advanced) // cc.every_n_batches):
             from .distributed.checkpoint import save_checkpoint
             save_checkpoint(cc.dirname, step=self.step,
                             main_program=self.main_program,

@@ -152,3 +152,67 @@ def test_trainer_test_preserves_step_counter():
     step_before = int(np.asarray(scope.find(STEP_VAR)))
     t.test(_reader(n_batches=5))
     assert int(np.asarray(scope.find(STEP_VAR))) == step_before
+
+
+def test_trainer_steps_per_dispatch():
+    """K steps per dispatch must advance training like K single-step
+    dispatches on the same batch, fire events once per dispatch, and
+    still hit stride-crossed checkpoint boundaries."""
+    import tempfile
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.trainer import (CheckpointConfig, EndIteration,
+                                    Trainer)
+
+    def build():
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4], dtype="float32")
+            label = layers.data("label", [1], dtype="float32")
+            pred = layers.fc(x, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, label))
+            pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    xv = rng.rand(16, 4).astype(np.float32)
+    yv = xv.sum(1, keepdims=True)
+
+    def reader():
+        for _ in range(3):
+            yield {"x": xv, "label": yv}
+
+    # baseline: the same 12 steps as 12 single-step dispatches
+    pt.reset_global_scope()
+    main, startup, loss = build()
+    t0 = Trainer(loss, main_program=main, startup_program=startup)
+    base_costs = []
+    t0.train(1, lambda: iter([{"x": xv, "label": yv}] * 12),
+             event_handler=lambda e: base_costs.append(e.cost)
+             if isinstance(e, EndIteration) else None)
+
+    # 3 dispatches of K=4 = 12 steps
+    pt.reset_global_scope()
+    main, startup, loss = build()
+    with tempfile.TemporaryDirectory() as d:
+        tr = Trainer(loss, main_program=main, startup_program=startup,
+                     checkpoint_config=CheckpointConfig(
+                         d, every_n_batches=5))
+        events = []
+        tr.train(1, reader, event_handler=lambda e: events.append(e)
+                 if isinstance(e, EndIteration) else None,
+                 steps_per_dispatch=4)
+        assert len(events) == 3           # one event per dispatch
+        assert tr.step == 12              # K per dispatch
+        import os
+        assert os.listdir(d), "stride-crossed checkpoint not written"
+    # K-scanned training must MATCH single-step training: the event
+    # after dispatch i carries the cost of step (i+1)*K, i.e. the loss
+    # computed FROM the state after (i+1)*K - 1 updates — compare each
+    # against the corresponding single-step cost
+    for i, ev in enumerate(events):
+        np.testing.assert_allclose(ev.cost, base_costs[(i + 1) * 4 - 1],
+                                   rtol=1e-4, atol=1e-6)
+    with pytest.raises(ValueError, match="steps_per_dispatch"):
+        tr.train(1, reader, steps_per_dispatch=0)
