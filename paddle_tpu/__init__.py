@@ -31,6 +31,7 @@ from . import flags  # noqa
 from . import debug  # noqa
 from .parallel import ParallelExecutor  # noqa
 from . import reader  # noqa
+from . import dataset  # noqa  (reference paddle/__init__.py imports it)
 from .reader import batch  # noqa
 from . import concurrency  # noqa
 from . import amp  # noqa
