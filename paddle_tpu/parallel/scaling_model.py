@@ -39,7 +39,7 @@ The MODEL, stated:
     eff_overlap = T_c / max(T_c, T_comm) (perfect overlap)
   Real XLA schedules land between the two.
 - T_c comes from the MEASURED single-chip benchmark throughput
-  (BENCH_r03, this repo) scaled to the per-chip workload of the
+  (round-4 chip runs, this repo — see ANCHORS) scaled to the per-chip workload of the
   layout: compute partitioning is taken as ideal, so ALL predicted
   loss comes from communication — which is exactly what the audit can
   see. FLOP-imbalance/recompute effects are out of scope and stated.
@@ -58,13 +58,17 @@ DCN_BW = 3.125e9     # bytes/s per chip (25 Gbit/s/chip host NIC share)
 DCN_LAT = 10e-6      # s per DCN hop
 PEAK_BF16 = 197e12   # FLOP/s
 
-# Measured single-chip anchors (BENCH_r03.json, this repo, real v5e):
-# (unit, per-replica batch in that unit, measured units/sec/chip)
+# Measured single-chip anchors (round-4 chip runs, real v5e):
+# (unit, per-replica batch in that unit, measured units/sec/chip).
+# deepfm uses the round-4 in-graph-scan measurement (590937, 0.9%
+# spread) — the round-3 888k carried a 32.6% spread and a re-run of
+# that noisy protocol on identical code swung to 428k (57.6%), i.e.
+# both bracket the trustworthy number rather than contradicting it.
 ANCHORS = {
-    "resnet50": ("images", 128, 2537.02),
-    "transformer": ("tokens", 32 * 256, 208454.0),
-    "transformer_dp": ("tokens", 32 * 256, 208454.0),
-    "deepfm": ("examples", 2048, 888130.0),
+    "resnet50": ("images", 128, 2576.86),
+    "transformer": ("tokens", 32 * 256, 206540.0),
+    "transformer_dp": ("tokens", 32 * 256, 206540.0),
+    "deepfm": ("examples", 2048, 590937.0),
 }
 
 
@@ -367,7 +371,7 @@ def scaling_report(n_list=(8, 16, 64), configs=("resnet50",
                 "deepfm": _config_deepfm}
     report: Dict = {"model": "ring-ICI analytic (see scaling_model.py)",
                     "ici_bw_B_per_s": ICI_BW, "ici_lat_s": ICI_LAT,
-                    "anchors_BENCH_r03": {k: v[2]
+                    "anchors_measured": {k: v[2]
                                           for k, v in ANCHORS.items()},
                     "configs": {}}
     for cfg in configs:
