@@ -97,8 +97,10 @@ def _adamax(ctx):
     b2 = ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-8)
     m_out = b1 * m + (1 - b1) * g
-    u_out = jnp.maximum(b2 * u, jnp.abs(g))
-    p_out = p - (lr / (1 - b1p)) * m_out / (u_out + eps)
+    # epsilon goes INSIDE the max, on the decayed-norm side
+    # (reference adamax_op.h: grad.abs().cwiseMax(beta2*inf_norm + eps))
+    u_out = jnp.maximum(jnp.abs(g), b2 * u + eps)
+    p_out = p - (lr / (1 - b1p)) * m_out / u_out
     ctx.set_output("ParamOut", p_out)
     ctx.set_output("MomentOut", m_out)
     ctx.set_output("InfNormOut", u_out)
@@ -222,14 +224,14 @@ def _proximal_adagrad(ctx):
     l1 = ctx.attr("l1", 0.0)
     l2 = ctx.attr("l2", 0.0)
     m_out = m + g * g
-    eff_lr = lr / jnp.sqrt(m_out)
-    prox = p - eff_lr * g
+    prox = p - lr * g / jnp.sqrt(m_out)
+    # the shrink thresholds scale by the BASE lr, not the per-element
+    # effective lr (reference proximal_adagrad_op.h: lr*l1, 1+lr*l2)
     if l1 > 0:
         out = (jnp.sign(prox) *
-               jnp.maximum(jnp.abs(prox) - eff_lr * l1, 0.0)) / \
-            (1.0 + eff_lr * l2)
+               jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)) / (1.0 + lr * l2)
     else:
-        out = prox / (1.0 + eff_lr * l2)
+        out = prox / (1.0 + lr * l2)
     ctx.set_output("ParamOut", out)
     ctx.set_output("MomentOut", m_out)
 

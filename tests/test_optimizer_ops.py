@@ -1,0 +1,435 @@
+"""NumPy-oracle checks for every optimizer update rule.
+
+The oracles below are transcribed from the REFERENCE kernels, not from our
+implementation, so they test reference semantics (reference:
+paddle/fluid/operators/{adagrad,adamax,adadelta,rmsprop,decayed_adagrad,
+ftrl,proximal_gd,proximal_adagrad,sgd,momentum,adam}_op.h; the reference
+tests each in python/paddle/fluid/tests/unittests/test_*_op.py with
+check_output only — update rules have no gradient path, so that is the
+full contract). lars_momentum has no reference counterpart (beyond-parity
+op); its oracle follows You et al. 2017.
+
+Every rule is run TWO chained steps — the second step feeds the first
+step's outputs back in, which catches accumulator-threading bugs a single
+application cannot.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from op_test import OpTestHarness
+
+
+def _rng(seed=0):
+    return np.random.RandomState(seed)
+
+
+def _run(op_type, inputs, attrs, out_slots):
+    t = OpTestHarness(op_type, inputs, attrs=attrs, out_slots=out_slots)
+    return t.outputs()
+
+
+def _two_step(op_type, state, grads, attrs, slot_map, extra_inputs=None):
+    """Run op twice, chaining state via slot_map {out_slot: in_slot}.
+    state: {in_slot: array}. grads: [g_step1, g_step2]. Returns list of
+    per-step output dicts."""
+    outs = []
+    cur = dict(state)
+    for g in grads:
+        inputs = {s: (s.lower(), v) for s, v in cur.items()}
+        inputs["Grad"] = ("grad", g)
+        if extra_inputs:
+            inputs.update({s: (s.lower() + "_x", v)
+                           for s, v in extra_inputs.items()})
+        got = _run(op_type, inputs, attrs, tuple(slot_map.keys()))
+        outs.append(got)
+        nxt = {slot_map[o]: got[o] for o in slot_map if slot_map[o]}
+        # inputs not produced as outputs (e.g. LearningRate) persist
+        nxt.update({s: v for s, v in cur.items() if s not in nxt})
+        cur = nxt
+    return outs
+
+
+LR = np.array([0.01], np.float32)
+
+
+def test_sgd_oracle():
+    r = _rng(1)
+    p = r.uniform(-1, 1, (4, 5)).astype(np.float32)
+    g = r.uniform(-1, 1, (4, 5)).astype(np.float32)
+    got = _run("sgd", {"Param": ("p", p), "Grad": ("g", g),
+                       "LearningRate": ("lr", LR)}, {}, ("ParamOut",))
+    np.testing.assert_allclose(got["ParamOut"], p - LR[0] * g, rtol=1e-6)
+
+
+def test_momentum_oracle():
+    r = _rng(2)
+    p = r.uniform(-1, 1, (3, 4)).astype(np.float32)
+    v = r.uniform(-1, 1, (3, 4)).astype(np.float32)
+    gs = [r.uniform(-1, 1, (3, 4)).astype(np.float32) for _ in range(2)]
+    mu = 0.9
+    outs = _two_step(
+        "momentum", {"Param": p, "Velocity": v,
+                     "LearningRate": LR}, gs, {"mu": mu},
+        {"ParamOut": "Param", "VelocityOut": "Velocity"},
+        )
+    # chain LearningRate manually: it is consumed unchanged
+    ep, ev = p.astype(np.float64), v.astype(np.float64)
+    for g, got in zip(gs, outs):
+        ev = mu * ev + g
+        ep = ep - LR[0] * ev
+        np.testing.assert_allclose(got["VelocityOut"], ev, rtol=1e-5)
+        np.testing.assert_allclose(got["ParamOut"], ep, rtol=1e-5)
+
+
+def _chain_lr(state):
+    st = dict(state)
+    st["LearningRate"] = LR
+    return st
+
+
+def test_momentum_nesterov_oracle():
+    r = _rng(3)
+    p = r.uniform(-1, 1, (6,)).astype(np.float32)
+    v = r.uniform(-1, 1, (6,)).astype(np.float32)
+    g = r.uniform(-1, 1, (6,)).astype(np.float32)
+    mu = 0.8
+    got = _run("momentum",
+               {"Param": ("p", p), "Grad": ("g", g), "Velocity": ("v", v),
+                "LearningRate": ("lr", LR)},
+               {"mu": mu, "use_nesterov": True},
+               ("ParamOut", "VelocityOut"))
+    v_out = mu * v + g
+    p_out = p - (g + mu * v_out) * LR[0]
+    np.testing.assert_allclose(got["VelocityOut"], v_out, rtol=1e-5)
+    np.testing.assert_allclose(got["ParamOut"], p_out, rtol=1e-5)
+
+
+def test_adam_oracle():
+    r = _rng(4)
+    shape = (2, 7)
+    p = r.uniform(-1, 1, shape).astype(np.float32)
+    m1 = np.zeros(shape, np.float32)
+    m2 = np.zeros(shape, np.float32)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    b1p = np.array([b1], np.float32)
+    b2p = np.array([b2], np.float32)
+    gs = [r.uniform(-1, 1, shape).astype(np.float32) for _ in range(2)]
+    ep, em1, em2 = (x.astype(np.float64) for x in (p, m1, m2))
+    eb1p, eb2p = float(b1p[0]), float(b2p[0])
+    for step, g in enumerate(gs):
+        got = _run("adam",
+                   {"Param": ("p", p), "Grad": ("g", g),
+                    "Moment1": ("m1", m1), "Moment2": ("m2", m2),
+                    "LearningRate": ("lr", LR),
+                    "Beta1Pow": ("b1p", b1p), "Beta2Pow": ("b2p", b2p)},
+                   {"beta1": b1, "beta2": b2, "epsilon": eps},
+                   ("ParamOut", "Moment1Out", "Moment2Out",
+                    "Beta1PowOut", "Beta2PowOut"))
+        em1 = b1 * em1 + (1 - b1) * g
+        em2 = b2 * em2 + (1 - b2) * g * g
+        lr_t = LR[0] * np.sqrt(1 - eb2p) / (1 - eb1p)
+        ep = ep - lr_t * em1 / (np.sqrt(em2) + eps)
+        np.testing.assert_allclose(got["ParamOut"], ep, rtol=2e-5, atol=1e-6)
+        np.testing.assert_allclose(got["Moment1Out"], em1, rtol=1e-5)
+        np.testing.assert_allclose(got["Moment2Out"], em2, rtol=1e-5)
+        eb1p *= b1
+        eb2p *= b2
+        np.testing.assert_allclose(got["Beta1PowOut"], [eb1p], rtol=1e-5)
+        p, m1, m2 = got["ParamOut"], got["Moment1Out"], got["Moment2Out"]
+        b1p, b2p = got["Beta1PowOut"], got["Beta2PowOut"]
+
+
+def test_adagrad_oracle():
+    r = _rng(5)
+    shape = (5, 3)
+    p = r.uniform(-1, 1, shape).astype(np.float32)
+    m = np.zeros(shape, np.float32)
+    eps = 1e-6
+    gs = [r.uniform(-1, 1, shape).astype(np.float32) for _ in range(2)]
+    ep, em = p.astype(np.float64), m.astype(np.float64)
+    for g in gs:
+        got = _run("adagrad",
+                   {"Param": ("p", p), "Grad": ("g", g), "Moment": ("m", m),
+                    "LearningRate": ("lr", LR)}, {"epsilon": eps},
+                   ("ParamOut", "MomentOut"))
+        em = em + g.astype(np.float64) ** 2
+        ep = ep - LR[0] * g / (np.sqrt(em) + eps)
+        np.testing.assert_allclose(got["MomentOut"], em, rtol=1e-5)
+        np.testing.assert_allclose(got["ParamOut"], ep, rtol=1e-5, atol=1e-7)
+        p, m = got["ParamOut"], got["MomentOut"]
+
+
+def test_adamax_oracle():
+    """Reference adamax_op.h: inf_norm_out = max(|g|, beta2*inf_norm+eps);
+    param_out = param - lr/(1-beta1_pow) * moment_out/inf_norm_out."""
+    r = _rng(6)
+    shape = (4, 4)
+    p = r.uniform(-1, 1, shape).astype(np.float32)
+    m = np.zeros(shape, np.float32)
+    u = np.zeros(shape, np.float32)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    b1p = np.array([b1], np.float32)
+    gs = [r.uniform(-1, 1, shape).astype(np.float32) for _ in range(2)]
+    ep, em, eu = (x.astype(np.float64) for x in (p, m, u))
+    eb1p = float(b1p[0])
+    for g in gs:
+        got = _run("adamax",
+                   {"Param": ("p", p), "Grad": ("g", g), "Moment": ("m", m),
+                    "InfNorm": ("u", u), "LearningRate": ("lr", LR),
+                    "Beta1Pow": ("b1p", b1p)},
+                   {"beta1": b1, "beta2": b2, "epsilon": eps},
+                   ("ParamOut", "MomentOut", "InfNormOut"))
+        em = b1 * em + (1 - b1) * g
+        eu = np.maximum(np.abs(g), b2 * eu + eps)
+        ep = ep - (LR[0] / (1 - eb1p)) * em / eu
+        np.testing.assert_allclose(got["MomentOut"], em, rtol=1e-5)
+        np.testing.assert_allclose(got["InfNormOut"], eu, rtol=1e-5)
+        np.testing.assert_allclose(got["ParamOut"], ep, rtol=1e-5, atol=1e-7)
+        p, m, u = got["ParamOut"], got["MomentOut"], got["InfNormOut"]
+        # Beta1Pow is updated by the Optimizer class via scale, not the op
+        eb1p *= b1
+        b1p = (b1p * b1).astype(np.float32)
+
+
+def test_adadelta_oracle():
+    r = _rng(7)
+    shape = (3, 6)
+    p = r.uniform(-1, 1, shape).astype(np.float32)
+    sg = np.zeros(shape, np.float32)
+    su = np.zeros(shape, np.float32)
+    rho, eps = 0.95, 1e-6
+    gs = [r.uniform(-1, 1, shape).astype(np.float32) for _ in range(2)]
+    ep, esg, esu = (x.astype(np.float64) for x in (p, sg, su))
+    for g in gs:
+        got = _run("adadelta",
+                   {"Param": ("p", p), "Grad": ("g", g),
+                    "AvgSquaredGrad": ("sg", sg),
+                    "AvgSquaredUpdate": ("su", su)},
+                   {"rho": rho, "epsilon": eps},
+                   ("ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"))
+        esg = rho * esg + (1 - rho) * g * g
+        update = -np.sqrt((esu + eps) / (esg + eps)) * g
+        esu = rho * esu + (1 - rho) * update * update
+        ep = ep + update
+        np.testing.assert_allclose(got["AvgSquaredGradOut"], esg, rtol=1e-5)
+        np.testing.assert_allclose(got["AvgSquaredUpdateOut"], esu,
+                                   rtol=1e-5, atol=1e-9)
+        np.testing.assert_allclose(got["ParamOut"], ep, rtol=1e-5, atol=1e-7)
+        p, sg, su = (got["ParamOut"], got["AvgSquaredGradOut"],
+                     got["AvgSquaredUpdateOut"])
+
+
+def test_rmsprop_oracle():
+    r = _rng(8)
+    shape = (2, 9)
+    p = r.uniform(-1, 1, shape).astype(np.float32)
+    mom = np.zeros(shape, np.float32)
+    ms = np.zeros(shape, np.float32)
+    rho, eps, mu = 0.9, 1e-6, 0.6
+    gs = [r.uniform(-1, 1, shape).astype(np.float32) for _ in range(2)]
+    ep, emom, ems = (x.astype(np.float64) for x in (p, mom, ms))
+    for g in gs:
+        got = _run("rmsprop",
+                   {"Param": ("p", p), "Grad": ("g", g),
+                    "Moment": ("mom", mom), "MeanSquare": ("ms", ms),
+                    "LearningRate": ("lr", LR)},
+                   {"decay": rho, "epsilon": eps, "momentum": mu},
+                   ("ParamOut", "MomentOut", "MeanSquareOut"))
+        ems = rho * ems + (1 - rho) * g * g
+        emom = mu * emom + LR[0] * g / np.sqrt(ems + eps)
+        ep = ep - emom
+        np.testing.assert_allclose(got["MeanSquareOut"], ems, rtol=1e-5)
+        np.testing.assert_allclose(got["MomentOut"], emom, rtol=1e-5)
+        np.testing.assert_allclose(got["ParamOut"], ep, rtol=1e-5, atol=1e-7)
+        p, mom, ms = (got["ParamOut"], got["MomentOut"],
+                      got["MeanSquareOut"])
+
+
+def test_decayed_adagrad_oracle():
+    r = _rng(9)
+    shape = (7,)
+    p = r.uniform(-1, 1, shape).astype(np.float32)
+    m = np.zeros(shape, np.float32)
+    decay, eps = 0.95, 1e-6
+    gs = [r.uniform(-1, 1, shape).astype(np.float32) for _ in range(2)]
+    ep, em = p.astype(np.float64), m.astype(np.float64)
+    for g in gs:
+        got = _run("decayed_adagrad",
+                   {"Param": ("p", p), "Grad": ("g", g), "Moment": ("m", m),
+                    "LearningRate": ("lr", LR)},
+                   {"decay": decay, "epsilon": eps},
+                   ("ParamOut", "MomentOut"))
+        em = decay * em + (1 - decay) * g * g
+        ep = ep - LR[0] * g / (np.sqrt(em) + eps)
+        np.testing.assert_allclose(got["MomentOut"], em, rtol=1e-5)
+        np.testing.assert_allclose(got["ParamOut"], ep, rtol=1e-5, atol=1e-7)
+        p, m = got["ParamOut"], got["MomentOut"]
+
+
+def _ftrl_oracle(p, sq, lin, g, lr, l1, l2, lr_power):
+    new_sq = sq + g * g
+    sigma = (new_sq ** (-lr_power) - sq ** (-lr_power)) / lr
+    lin_out = lin + g - sigma * p
+    y = new_sq ** (-lr_power) / lr + 2 * l2
+    x = l1 * np.sign(lin_out) - lin_out
+    p_out = np.where(np.abs(lin_out) > l1, x / y, np.zeros_like(p))
+    return p_out, new_sq, lin_out
+
+
+@pytest.mark.parametrize("l1,lr_power", [(0.1, -0.5), (0.0, -0.5),
+                                         (0.05, -0.3)])
+def test_ftrl_oracle(l1, lr_power):
+    r = _rng(10)
+    shape = (3, 5)
+    p = r.uniform(-1, 1, shape).astype(np.float32)
+    sq = np.full(shape, 0.1, np.float32)  # reference tests start sq>0
+    lin = r.uniform(-0.5, 0.5, shape).astype(np.float32)
+    l2 = 0.2
+    gs = [r.uniform(-1, 1, shape).astype(np.float32) for _ in range(2)]
+    ep, esq, elin = (x.astype(np.float64) for x in (p, sq, lin))
+    for g in gs:
+        got = _run("ftrl",
+                   {"Param": ("p", p), "Grad": ("g", g),
+                    "SquaredAccumulator": ("sq", sq),
+                    "LinearAccumulator": ("lin", lin),
+                    "LearningRate": ("lr", LR)},
+                   {"l1": l1, "l2": l2, "lr_power": lr_power},
+                   ("ParamOut", "SquaredAccumOut", "LinearAccumOut"))
+        ep, esq, elin = _ftrl_oracle(ep, esq, elin, g, LR[0], l1, l2,
+                                     lr_power)
+        np.testing.assert_allclose(got["SquaredAccumOut"], esq, rtol=1e-5)
+        np.testing.assert_allclose(got["LinearAccumOut"], elin, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(got["ParamOut"], ep, rtol=1e-4,
+                                   atol=1e-5)
+        p, sq, lin = (got["ParamOut"], got["SquaredAccumOut"],
+                      got["LinearAccumOut"])
+
+
+@pytest.mark.parametrize("l1", [0.0, 0.05])
+def test_proximal_gd_oracle(l1):
+    r = _rng(11)
+    shape = (4, 3)
+    p = r.uniform(-1, 1, shape).astype(np.float32)
+    g = r.uniform(-1, 1, shape).astype(np.float32)
+    l2 = 0.1
+    got = _run("proximal_gd",
+               {"Param": ("p", p), "Grad": ("g", g),
+                "LearningRate": ("lr", LR)},
+               {"l1": l1, "l2": l2}, ("ParamOut",))
+    prox = p - LR[0] * g
+    if l1 > 0:
+        exp = np.sign(prox) * np.maximum(np.abs(prox) - LR[0] * l1, 0.0) \
+            / (1.0 + LR[0] * l2)
+    else:
+        exp = prox / (1.0 + LR[0] * l2)
+    np.testing.assert_allclose(got["ParamOut"], exp, rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.parametrize("l1", [0.0, 0.05])
+def test_proximal_adagrad_oracle(l1):
+    """Shrink thresholds use the BASE lr (reference proximal_adagrad_op.h:
+    lr*l1 and 1+lr*l2, NOT the per-element lr/sqrt(moment))."""
+    r = _rng(12)
+    shape = (6,)
+    p = r.uniform(-1, 1, shape).astype(np.float32)
+    m = np.full(shape, 0.1, np.float32)
+    l2 = 0.1
+    gs = [r.uniform(-1, 1, shape).astype(np.float32) for _ in range(2)]
+    ep, em = p.astype(np.float64), m.astype(np.float64)
+    for g in gs:
+        got = _run("proximal_adagrad",
+                   {"Param": ("p", p), "Grad": ("g", g), "Moment": ("m", m),
+                    "LearningRate": ("lr", LR)},
+                   {"l1": l1, "l2": l2}, ("ParamOut", "MomentOut"))
+        em = em + g.astype(np.float64) ** 2
+        prox = ep - LR[0] * g / np.sqrt(em)
+        if l1 > 0:
+            ep = np.sign(prox) * np.maximum(np.abs(prox) - LR[0] * l1, 0.0) \
+                / (1.0 + LR[0] * l2)
+        else:
+            ep = prox / (1.0 + LR[0] * l2)
+        np.testing.assert_allclose(got["MomentOut"], em, rtol=1e-5)
+        np.testing.assert_allclose(got["ParamOut"], ep, rtol=1e-5,
+                                   atol=1e-7)
+        p, m = got["ParamOut"], got["MomentOut"]
+
+
+def test_lars_momentum_oracle():
+    """No reference counterpart; oracle = LARS (You et al. 2017):
+    local_lr = lr * coeff * ||p|| / (||g|| + decay*||p||);
+    v' = mu*v + local_lr*(g + decay*p); p' = p - v'."""
+    r = _rng(13)
+    shape = (5, 4)
+    p = r.uniform(-1, 1, shape).astype(np.float32)
+    v = np.zeros(shape, np.float32)
+    mu, coeff, decay = 0.9, 0.001, 0.0005
+    gs = [r.uniform(-1, 1, shape).astype(np.float32) for _ in range(2)]
+    ep, ev = p.astype(np.float64), v.astype(np.float64)
+    for g in gs:
+        got = _run("lars_momentum",
+                   {"Param": ("p", p), "Grad": ("g", g),
+                    "Velocity": ("v", v), "LearningRate": ("lr", LR)},
+                   {"mu": mu, "lars_coeff": coeff,
+                    "lars_weight_decay": decay},
+                   ("ParamOut", "VelocityOut"))
+        p_norm = np.sqrt((ep ** 2).sum())
+        g_norm = np.sqrt((g.astype(np.float64) ** 2).sum())
+        local_lr = LR[0] * coeff * p_norm / (g_norm + decay * p_norm
+                                             + 1e-12)
+        ev = mu * ev + local_lr * (g + decay * ep)
+        ep = ep - ev
+        np.testing.assert_allclose(got["VelocityOut"], ev, rtol=1e-5,
+                                   atol=1e-9)
+        np.testing.assert_allclose(got["ParamOut"], ep, rtol=1e-5)
+        p, v = got["ParamOut"], got["VelocityOut"]
+
+
+# -- end-to-end: every Optimizer class drives a tiny regression ------------
+
+OPT_CLASSES = [
+    ("SGDOptimizer", {}),
+    ("MomentumOptimizer", {"momentum": 0.9}),
+    ("AdagradOptimizer", {}),
+    ("AdamOptimizer", {}),
+    ("AdamaxOptimizer", {}),
+    ("DecayedAdagradOptimizer", {}),
+    ("AdadeltaOptimizer", {}),
+    ("RMSPropOptimizer", {}),
+    ("FtrlOptimizer", {}),
+    ("LarsMomentumOptimizer", {"momentum": 0.9}),
+]
+
+
+@pytest.mark.parametrize("cls_name,kwargs", OPT_CLASSES)
+def test_optimizer_class_decreases_loss(cls_name, kwargs):
+    """Each Optimizer class minimizes least squares for 10 steps; the loss
+    must drop. Exercises accumulator creation + the update op end-to-end
+    (reference surface: python/paddle/fluid/optimizer.py:250-808)."""
+    from paddle_tpu import layers
+    pt.reset_default_programs()
+    cls = getattr(pt.optimizer, cls_name)
+    # Adadelta/Ftrl move slowly at small lr; crank it so 10 steps show
+    lr = {"AdadeltaOptimizer": 1.0, "FtrlOptimizer": 0.5,
+          "LarsMomentumOptimizer": 10.0}.get(cls_name, 0.1)
+    x = layers.data("x", [4, 3], append_batch_size=False)
+    y = layers.data("y", [4, 1], append_batch_size=False)
+    pred = layers.fc(x, size=1)
+    loss = layers.reduce_mean(layers.square(pred - y))
+    cls(learning_rate=lr, **kwargs).minimize(loss)
+
+    r = _rng(99)
+    xv = r.uniform(-1, 1, (4, 3)).astype(np.float32)
+    yv = (xv @ np.array([[1.0], [-2.0], [0.5]]) + 0.3).astype(np.float32)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    losses = []
+    for _ in range(10):
+        (lv,) = exe.run(pt.default_main_program(),
+                        feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv)))
+    assert losses[-1] < losses[0] * 0.9, (cls_name, losses)
+    assert np.isfinite(losses).all(), (cls_name, losses)
