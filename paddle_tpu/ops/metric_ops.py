@@ -103,11 +103,12 @@ def _edit_distance(ctx):
         row0 = jnp.arange(n + 1, dtype=jnp.float32)
         row0 = jnp.where(jnp.arange(n + 1) <= rlen, row0, big)
 
-        def outer(i, row):
+        def outer(i, carry):
+            row, ans = carry
             ins_cost = jnp.where(i < hlen + 1, i + 0.0, big)
 
-            def inner(j, carry):
-                row_new, prev_diag = carry
+            def inner(j, icarry):
+                row_new, prev_diag = icarry
                 sub = prev_diag + (hrow[i - 1] != rrow[j - 1])
                 val = jnp.minimum(jnp.minimum(row[j] + 1,
                                               row_new[j - 1] + 1), sub)
@@ -117,10 +118,15 @@ def _edit_distance(ctx):
             row_new = jnp.full((n + 1,), big).at[0].set(ins_cost)
             row_new, _ = jax.lax.fori_loop(
                 1, n + 1, inner, (row_new, row[0]))
-            return row_new
+            # capture the answer at the hyp's TRUE length: rows past hlen
+            # are all `big` (padding), so the final row is wrong whenever
+            # hlen < m — snapshot when i == hlen instead
+            ans = jnp.where(i == hlen, row_new[rlen.astype(jnp.int32)], ans)
+            return row_new, ans
 
-        final = jax.lax.fori_loop(1, m + 1, outer, row0)
-        return final[rlen.astype(jnp.int32)]
+        ans0 = row0[rlen.astype(jnp.int32)]  # hlen == 0: all-insertions
+        _, ans = jax.lax.fori_loop(1, m + 1, outer, (row0, ans0))
+        return ans
 
     dist = jax.vmap(per_pair)(hd, h.lengths, rd, r.lengths)
     if ctx.attr("normalized", False):

@@ -223,19 +223,42 @@ def _masked_select(ctx):
 
 @register_op("lod_reset", no_grad_slots=["Y"], ragged_aware=True)
 def _lod_reset(ctx):
-    """Re-segment a ragged tensor with new sequence lengths
-    (reference: lod_reset_op.cc). Dense in, dense out (lengths attached)."""
+    """Re-segment flat sequence steps with new lengths (reference:
+    lod_reset_op.cc: same data, new LoD). In the padded representation
+    that means REPACKING the flat step rows into [num_seq, T, ...] —
+    just attaching new lengths to the old layout would mis-segment."""
     x = ctx.input("X")
-    data = x.data if isinstance(x, RaggedPair) else x
+    if isinstance(x, RaggedPair):
+        # flatten to ordered valid steps first (stable mask compaction)
+        b, t = x.data.shape[:2]
+        flat = x.data.reshape((b * t,) + x.data.shape[2:])
+        valid = (jnp.arange(t)[None, :] < x.lengths[:, None]).reshape(-1)
+        flat = flat[jnp.argsort(~valid, stable=True)]
+    else:
+        flat = x
+    n = flat.shape[0]
     y = ctx.input("Y")
     if y is not None:
-        lengths = y.lengths if isinstance(y, RaggedPair) else y
-        ctx.set_output("Out", RaggedPair(data, lengths))
+        if isinstance(y, RaggedPair):
+            lengths = y.lengths
+            t_out = y.data.shape[1]
+        else:  # dense int vector of new lengths; bound T by step count
+            lengths = y.reshape(-1).astype(jnp.int32)
+            t_out = n
     else:
         target = ctx.attr("target_lod")
-        lengths = jnp.asarray([target[i + 1] - target[i]
-                               for i in range(len(target) - 1)], jnp.int32)
-        ctx.set_output("Out", RaggedPair(data, lengths))
+        lens_py = [target[i + 1] - target[i]
+                   for i in range(len(target) - 1)]
+        lengths = jnp.asarray(lens_py, jnp.int32)
+        t_out = max(lens_py) if lens_py else 1
+    starts = jnp.cumsum(lengths) - lengths
+    pos = jnp.arange(t_out)
+    idx = jnp.clip(starts[:, None] + pos[None, :], 0, n - 1)
+    padded = flat[idx]
+    mask = (pos[None, :] < lengths[:, None])
+    mask = mask.reshape(mask.shape + (1,) * (padded.ndim - 2))
+    ctx.set_output("Out", RaggedPair(padded * mask.astype(padded.dtype),
+                                     lengths.astype(jnp.int32)))
 
 
 @register_op("linspace", no_grad_slots=["Start", "Stop", "Num"])
@@ -298,3 +321,25 @@ def _crop(ctx):
     offsets += [0] * (x.ndim - len(offsets))
     idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
     ctx.set_output("Out", x[idx])
+
+
+@register_op("scale_sub_region", no_grad_slots=["Indices"])
+def _scale_sub_region(ctx):
+    """Scale a per-sample sub-region of a [b, C, H, W] feature map by a
+    constant (reference: ScaleSubRegionLayer / scale_sub_region_op.cc;
+    Indices holds 1-based inclusive [c1, c2, h1, h2, w1, w2] per
+    sample). Mask built by broadcast range-compares so shapes stay
+    static under jit."""
+    x = ctx.input("X")
+    idx = ctx.input("Indices").astype(jnp.int32)  # [b, 6], 1-based
+    value = ctx.attr("value", 1.0)
+    _, c, h, w = x.shape
+    rc = jnp.arange(1, c + 1)
+    rh = jnp.arange(1, h + 1)
+    rw = jnp.arange(1, w + 1)
+    mc = (rc[None, :] >= idx[:, 0:1]) & (rc[None, :] <= idx[:, 1:2])
+    mh = (rh[None, :] >= idx[:, 2:3]) & (rh[None, :] <= idx[:, 3:4])
+    mw = (rw[None, :] >= idx[:, 4:5]) & (rw[None, :] <= idx[:, 5:6])
+    mask = (mc[:, :, None, None] & mh[:, None, :, None]
+            & mw[:, None, None, :])
+    ctx.set_output("Out", jnp.where(mask, x * value, x))
