@@ -236,8 +236,14 @@ class DynamicRNN:
                     f"({self._mem_pre[i].name!r}) was declared but "
                     "update_memory() was never called for it")
         helper = self.helper
+        # carry the per-step feature shape onto the ragged results so
+        # downstream layers (fc after sequence_pool/last_step) can
+        # size their parameters (declared shape convention: [batch,
+        # *feature], time axis implicit in lod_level=1)
         self._result_vars = [
-            helper.create_tmp_variable(o.dtype, lod_level=1)
+            helper.create_tmp_variable(
+                o.dtype, lod_level=1,
+                shape=list(o.shape) if o.shape else None)
             for o in self._outputs]
         self._last_mem_vars = [
             helper.create_tmp_variable(m.dtype, shape=list(m.shape)

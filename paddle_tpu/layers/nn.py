@@ -23,6 +23,7 @@ __all__ = [
     "topk", "sequence_pool", "sequence_conv", "sequence_softmax",
     "sequence_expand", "sequence_first_step", "sequence_last_step",
     "sequence_reshape", "sequence_mask", "sequence_pad", "sequence_unpad",
+    "sequence_reverse",
     "nested_sequence_flatten", "nested_sequence_pack",
     "im2sequence", "matmul", "mul", "softmax", "log_softmax", "relu", "lrn",
     "l2_normalize", "mean", "reduce_sum", "reduce_mean", "reduce_max",
@@ -442,16 +443,21 @@ def topk(input, k):
 
 def sequence_pool(input, pool_type):
     helper = LayerHelper("sequence_pool")
-    out = helper.create_tmp_variable(input.dtype)
+    # reduction keeps the per-step feature shape
+    out = helper.create_tmp_variable(
+        input.dtype, shape=list(input.shape) if input.shape else None)
+    # both spellings circulate: fluid pool2d-style "avg", v2 "average"
+    ptype = {"AVG": "AVERAGE"}.get(pool_type.upper(), pool_type.upper())
     helper.append_op(type="sequence_pool", inputs={"X": input},
                      outputs={"Out": out},
-                     attrs={"pooltype": pool_type.upper()})
+                     attrs={"pooltype": ptype})
     return out
 
 
 def sequence_first_step(input):
     helper = LayerHelper("sequence_first_step")
-    out = helper.create_tmp_variable(input.dtype)
+    out = helper.create_tmp_variable(
+        input.dtype, shape=list(input.shape) if input.shape else None)
     helper.append_op(type="sequence_first_step", inputs={"X": input},
                      outputs={"Out": out})
     return out
@@ -459,9 +465,20 @@ def sequence_first_step(input):
 
 def sequence_last_step(input):
     helper = LayerHelper("sequence_last_step")
-    out = helper.create_tmp_variable(input.dtype)
+    out = helper.create_tmp_variable(
+        input.dtype, shape=list(input.shape) if input.shape else None)
     helper.append_op(type="sequence_last_step", inputs={"X": input},
                      outputs={"Out": out})
+    return out
+
+
+def sequence_reverse(x, name=None):
+    """Reverse each sequence's valid steps (reference:
+    sequence_reverse_op.h)."""
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = helper.create_tmp_variable(x.dtype, lod_level=1)
+    helper.append_op(type="sequence_reverse", inputs={"X": x},
+                     outputs={"Y": out})
     return out
 
 

@@ -995,3 +995,50 @@ def _sampling_id(ctx):
     ids = jax.random.categorical(_op_key(ctx), logits, axis=-1)
     ctx.set_output("Out", ids.astype(jnp.int64))
 
+
+
+@register_op("mdlstm")
+def _mdlstm(ctx):
+    """2-D multi-dimensional LSTM (reference: MDLstmLayer,
+    paddle/gserver/layers/MDLstmLayer.cpp — grid recurrence where each
+    cell sees the states of its LEFT and TOP neighbours). TPU-native
+    realization: lax.scan over rows carrying the whole previous row's
+    (h, c); an inner scan over columns carries (h_left, c_left). Gate
+    pre-activations from the input projection come in as X [b,H,W,5h]
+    (i, f_left, f_top, o, g); recurrent weights Wl/Wt are [h, 5h]."""
+    x = ctx.input("X")                       # [b, H, W, 5h]
+    wl = ctx.input("WeightLeft")             # [h, 5h]
+    wt = ctx.input("WeightTop")              # [h, 5h]
+    b_, hgt, wid, five_h = x.shape
+    hsz = five_h // 5
+
+    def split_gates(g):
+        i, fl, ft, o, c = jnp.split(g, 5, axis=-1)
+        return (jax.nn.sigmoid(i), jax.nn.sigmoid(fl),
+                jax.nn.sigmoid(ft), jax.nn.sigmoid(o), jnp.tanh(c))
+
+    def row_step(row_carry, x_row):
+        h_top, c_top = row_carry                 # [b, W, h] each
+
+        def col_step(col_carry, inp):
+            h_left, c_left = col_carry           # [b, h]
+            x_cell, h_up, c_up = inp             # [b,5h], [b,h], [b,h]
+            gates = x_cell + h_left @ wl + h_up @ wt
+            i, fl, ft, o, g = split_gates(gates)
+            c = i * g + fl * c_left + ft * c_up
+            h = o * jnp.tanh(c)
+            return (h, c), (h, c)
+
+        zeros = jnp.zeros((b_, hsz), x.dtype)
+        (_, _), (h_row, c_row) = jax.lax.scan(
+            col_step, (zeros, zeros),
+            (x_row.transpose(1, 0, 2),           # [W, b, 5h]
+             h_top.transpose(1, 0, 2), c_top.transpose(1, 0, 2)))
+        h_row = h_row.transpose(1, 0, 2)         # [b, W, h]
+        c_row = c_row.transpose(1, 0, 2)
+        return (h_row, c_row), h_row
+
+    zeros_row = jnp.zeros((b_, wid, hsz), x.dtype)
+    (_, _), hs = jax.lax.scan(row_step, (zeros_row, zeros_row),
+                              x.transpose(1, 0, 2, 3))  # [H, b, W, 5h]
+    ctx.set_output("Out", hs.transpose(1, 0, 2, 3))     # [b, H, W, h]

@@ -737,3 +737,19 @@ def _ctc_align(ctx):
     scatter_pos = jnp.where(keep, pos, T)
     buf = buf.at[jnp.arange(B)[:, None], scatter_pos].set(ids)
     ctx.set_output("Output", RaggedPair(buf[:, :T, None], out_lens))
+
+
+@register_op_SEQ("sequence_reverse")
+def _sequence_reverse(ctx):
+    """Reverse each sequence's valid prefix, padding stays in place
+    (reference: sequence_reverse_op.h). Powers reverse=True recurrences
+    built on the masked-scan DynamicRNN."""
+    x = _as_ragged(ctx.input("X"))
+    t = jnp.arange(x.data.shape[1], dtype=jnp.int32)
+    lens = x.lengths.astype(jnp.int32)
+    src = jnp.where(t[None, :] < lens[:, None],
+                    lens[:, None] - 1 - t[None, :], t[None, :])
+    out = jnp.take_along_axis(
+        x.data, src.reshape(src.shape + (1,) * (x.data.ndim - 2)),
+        axis=1)
+    ctx.set_output("Y", RaggedPair(out, x.lengths))

@@ -36,12 +36,21 @@ class Inference:
             res = self._exe.run(self._main, feed=feeder.feed(batch),
                                 fetch_list=fetch_vars,
                                 scope=self._parameters.scope)
-            outs.append([np.asarray(r) for r in res])
+            outs.append([_to_array(r) for r in res])
         if len(fetch_vars) == 1:
                 return np.concatenate([o[0] for o in outs], axis=0)
         # multiple output layers: tuple of concatenated arrays
         return tuple(np.concatenate([o[i] for o in outs], axis=0)
                      for i in range(len(fetch_vars)))
+
+
+def _to_array(r) -> np.ndarray:
+    """Fetched value -> ndarray: LoDTensor fetches (ragged outputs)
+    yield their flat step rows; scalar costs become 1-element rows so
+    per-batch results stay concatenatable."""
+    if hasattr(r, "data") and hasattr(r, "lod"):   # LoDTensor
+        return np.asarray(r.data)
+    return np.atleast_1d(np.asarray(r))
 
 
 def _batches(input):

@@ -457,6 +457,7 @@ def lstmemory(input, name=None, reverse=False, act=None,
             candidate_activation=act_name(act) or "tanh",
             param_attr=_pattr(param_attr, f"{node.name}.w0"),
             bias_attr=_pattr(bias_attr, f"{node.name}.wbias"))
+        ctx[(id(node), "state")] = _cell  # for get_output(..., 'state')
         return hidden
 
     node._build = build
@@ -528,6 +529,1197 @@ regression_cost = square_error_cost
 
 
 # ---------------------------------------------------------------------
+# raw-op plumbing for layers whose op has no fluid-layers wrapper
+# ---------------------------------------------------------------------
+
+def _raw_op(op_type, inputs, attrs=None, out_slots=("Out",),
+            dtype="float32", lod_out=()):
+    """Append one op via LayerHelper; returns {slot: var}."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper(op_type)
+    outs = {}
+    for s in out_slots:
+        outs[s] = helper.create_tmp_variable(
+            dtype, lod_level=1 if s in lod_out else 0)
+    helper.append_op(type=op_type, inputs=inputs,
+                     outputs={s: [v] for s, v in outs.items()},
+                     attrs=attrs or {})
+    return outs
+
+
+def _param(shape, name, initializer=None):
+    return F.create_parameter(list(shape), "float32", name=name,
+                              default_initializer=initializer)
+
+
+# ---------------------------------------------------------------------
+# image / feature-map layers
+# ---------------------------------------------------------------------
+
+def bilinear_interp(input, out_size_x, out_size_y, num_channels=None,
+                    name=None, **_kw):
+    """reference: BilinearInterpLayer (bilinear_interp_layer.cpp)."""
+    (inp,) = _listify(input)
+    node = Layer("bilinear_interp", parents=[inp], name=name)
+
+    def build(ctx):
+        var, (c, h, w) = _image_of(inp, inp.to_var(ctx), num_channels)
+        node.img_shape = (c, out_size_y, out_size_x)
+        return F.bilinear_interp(var, out_shape=[out_size_y, out_size_x])
+
+    node._build = build
+    return node
+
+
+def block_expand(input, block_x, block_y, stride_x=None, stride_y=None,
+                 num_channels=None, padding_x=0, padding_y=0, name=None,
+                 **_kw):
+    """Image -> sequence of flattened patches (reference:
+    BlockExpandLayer -> im2sequence_op.cc)."""
+    (inp,) = _listify(input)
+    node = Layer("blockexpand", parents=[inp], name=name)
+
+    def build(ctx):
+        var, _shape = _image_of(inp, inp.to_var(ctx), num_channels)
+        return F.im2sequence(var, filter_size=(block_y, block_x),
+                             stride=(stride_y or block_y,
+                                     stride_x or block_x),
+                             padding=(padding_y, padding_x))
+
+    node._build = build
+    return node
+
+
+def clip_layer(input, min, max, name=None):
+    (inp,) = _listify(input)
+    node = Layer("clip", parents=[inp], name=name)
+    node._build = lambda ctx: F.clip(inp.to_var(ctx), float(min),
+                                     float(max))
+    return node
+
+
+def conv3d(input, filter_size, num_filters, num_channels=None,
+           stride=1, padding=0, act=None, name=None, param_attr=None,
+           bias_attr=None, input_shape=None, trans=False, **_kw):
+    """3-D convolution (reference: Conv3DLayer / conv3d_op).
+    input_shape=(C, D, H, W) interprets a flat dense input."""
+    (inp,) = _listify(input)
+    node = Layer("deconv3d" if trans else "conv3d", parents=[inp],
+                 name=name, size=num_filters)
+
+    def build(ctx):
+        var = inp.to_var(ctx)
+        if len(var.shape) != 5:
+            if input_shape is None:
+                raise ValueError("conv3d on a flat input needs "
+                                 "input_shape=(C, D, H, W)")
+            var = F.reshape(var, [-1] + list(input_shape))
+        cin = int(var.shape[1])
+        k = filter_size if isinstance(filter_size, (list, tuple)) \
+            else (filter_size,) * 3
+        # transpose conv keeps the reference's [Cin, Cout, ...] layout
+        fshape = [cin, num_filters] if trans else [num_filters, cin]
+        w = _param(fshape + list(k), f"{node.name}.w0")
+        out = _raw_op("conv3d_transpose" if trans else "conv3d",
+                      {"Input": var, "Filter": w},
+                      attrs={"strides": [stride] * 3,
+                             "paddings": [padding] * 3,
+                             "dilations": [1, 1, 1],
+                             **({} if trans else {"groups": 1})},
+                      out_slots=("Output",))["Output"]
+        return _apply_act(out, act)
+
+    node._build = build
+    return node
+
+
+def deconv3d(input, filter_size, num_filters, **kw):
+    return conv3d(input, filter_size, num_filters, trans=True, **kw)
+
+
+def pad(input, pad_c=None, pad_h=None, pad_w=None, num_channels=None,
+        name=None, **_kw):
+    """Zero-pad an image along channel/height/width (reference:
+    PadLayer; each pad_* is a [before, after] pair)."""
+    (inp,) = _listify(input)
+    node = Layer("pad", parents=[inp], name=name)
+
+    def build(ctx):
+        var, (c, h, w) = _image_of(inp, inp.to_var(ctx), num_channels)
+        pc = pad_c or [0, 0]
+        ph = pad_h or [0, 0]
+        pw = pad_w or [0, 0]
+        node.img_shape = (c + sum(pc), h + sum(ph), w + sum(pw))
+        return F.pad(var, [0, 0, pc[0], pc[1], ph[0], ph[1],
+                           pw[0], pw[1]])
+
+    node._build = build
+    return node
+
+
+def pool3d(input, pool_size, num_channels=None, pool_type=None,
+           stride=1, padding=0, input_shape=None, name=None, **_kw):
+    (inp,) = _listify(input)
+    node = Layer("pool3d", parents=[inp], name=name)
+    ptype = (pool_type or _pooling.Max()).fluid_name
+
+    def build(ctx):
+        var = inp.to_var(ctx)
+        if len(var.shape) != 5:
+            if input_shape is None:
+                raise ValueError("pool3d on a flat input needs "
+                                 "input_shape=(C, D, H, W)")
+            var = F.reshape(var, [-1] + list(input_shape))
+        return _raw_op("pool3d", {"X": var},
+                       attrs={"ksize": [pool_size] * 3,
+                              "strides": [stride] * 3,
+                              "paddings": [padding] * 3,
+                              "pooling_type": ptype,
+                              "global_pooling": False,
+                              "exclusive": True})["Out"]
+
+    node._build = build
+    return node
+
+
+def rotate(input, height=None, width=None, num_channels=None,
+           name=None):
+    """Rotate each feature map 90 degrees counter-clockwise
+    (reference: RotateLayer: out[h', w'] = in[w, H-1-h'])."""
+    (inp,) = _listify(input)
+    node = Layer("rotate", parents=[inp], name=name)
+
+    def build(ctx):
+        var, (c, h, w) = _image_of(inp, inp.to_var(ctx), num_channels)
+        out = F.transpose(var, [0, 1, 3, 2])     # swap H and W
+        node.img_shape = (c, w, h)
+        return F.reverse(out, [2])               # flip the new H axis
+
+    node._build = build
+    return node
+
+
+def switch_order(input, reshape_order=(0, 2, 3, 1), num_channels=None,
+                 name=None, **_kw):
+    """NCHW -> NHWC reorder (reference: SwitchOrderLayer)."""
+    (inp,) = _listify(input)
+    node = Layer("switch_order", parents=[inp], name=name)
+
+    def build(ctx):
+        var, _s = _image_of(inp, inp.to_var(ctx), num_channels)
+        return F.transpose(var, list(reshape_order))
+
+    node._build = build
+    return node
+
+
+def crop(input, shape=None, offsets=None, num_channels=None, name=None,
+         **_kw):
+    (inp,) = _listify(input)
+    node = Layer("crop", parents=[inp], name=name)
+
+    def build(ctx):
+        var, _s = _image_of(inp, inp.to_var(ctx), num_channels)
+        return F.crop(var, shape=shape, offsets=offsets)
+
+    node._build = build
+    return node
+
+
+def upsample(input, scale=2, num_channels=None, name=None, **_kw):
+    (inp,) = _listify(input)
+    node = Layer("upsample", parents=[inp], name=name)
+
+    def build(ctx):
+        var, (c, h, w) = _image_of(inp, inp.to_var(ctx), num_channels)
+        node.img_shape = (c, h * scale, w * scale)
+        return F.upsample(var, scale=scale)
+
+    node._build = build
+    return node
+
+
+def resize(input, size, name=None):
+    """Reinterpret the minibatch matrix as rows of `size` elements
+    (reference: ResizeLayer — a pure reshape, despite the name)."""
+    (inp,) = _listify(input)
+    node = Layer("resize", parents=[inp], name=name, size=size)
+    node._build = lambda ctx: F.reshape(inp.to_var(ctx), [-1, size])
+    return node
+
+
+def scale_sub_region(input, indices, value, num_channels=None,
+                     name=None):
+    """Scale a per-sample [c1,c2,h1,h2,w1,w2] sub-region by `value`
+    (reference: ScaleSubRegionLayer)."""
+    node = Layer("scale_sub_region", parents=[input, indices], name=name)
+
+    def build(ctx):
+        var, _s = _image_of(input, input.to_var(ctx), num_channels)
+        return _raw_op("scale_sub_region",
+                       {"X": var, "Indices": indices.to_var(ctx)},
+                       attrs={"value": float(value)})["Out"]
+
+    node._build = build
+    return node
+
+
+def prelu(input, param_attr=None, name=None, **_kw):
+    (inp,) = _listify(input)
+    node = Layer("prelu", parents=[inp], name=name)
+    node._build = lambda ctx: F.prelu(
+        inp.to_var(ctx), mode="all",
+        param_attr=_pattr(param_attr, f"{node.name}.w0"))
+    return node
+
+
+# ---------------------------------------------------------------------
+# projections / algebra layers
+# ---------------------------------------------------------------------
+
+mixed = fc  # mixed_layer sums full-matrix projections; fc(input=[...])
+            # is exactly that realization (reference: MixedLayer.cpp)
+
+
+def dot_prod(a, b, name=None):
+    """Row-wise dot product (reference: DotProdLayer)."""
+    node = Layer("dot_prod", parents=[a, b], name=name)
+    node._build = lambda ctx: F.reduce_sum(
+        F.elementwise_mul(a.to_var(ctx), b.to_var(ctx)),
+        dim=-1, keep_dim=True)
+    return node
+
+
+def out_prod(a, b, name=None):
+    """Row-wise outer product flattened to [bs, m*n] (reference:
+    OuterProdLayer)."""
+    node = Layer("out_prod", parents=[a, b], name=name)
+
+    def build(ctx):
+        av, bv = a.to_var(ctx), b.to_var(ctx)
+        m, n = int(av.shape[-1]), int(bv.shape[-1])
+        prod = F.matmul(F.reshape(av, [-1, m, 1]),
+                        F.reshape(bv, [-1, 1, n]))
+        return F.reshape(prod, [-1, m * n])
+
+    node._build = build
+    return node
+
+
+def l2_distance(a, b, name=None):
+    node = Layer("l2_distance", parents=[a, b], name=name)
+
+    def build(ctx):
+        d = F.elementwise_sub(a.to_var(ctx), b.to_var(ctx))
+        return F.sqrt(F.reduce_sum(F.square(d), dim=-1, keep_dim=True))
+
+    node._build = build
+    return node
+
+
+def linear_comb(weights, vectors, size, name=None):
+    """Convex/linear combination: weights [bs, M] over vectors
+    [bs, M*size] -> [bs, size] (reference: LinearCombLayer, type
+    'convex_comb')."""
+    node = Layer("convex_comb", parents=[weights, vectors], name=name,
+                 size=size)
+
+    def build(ctx):
+        w = weights.to_var(ctx)
+        v = vectors.to_var(ctx)
+        m = int(w.shape[-1])
+        v3 = F.reshape(v, [-1, m, size])
+        return F.reshape(
+            F.matmul(F.reshape(w, [-1, 1, m]), v3), [-1, size])
+
+    node._build = build
+    return node
+
+
+def interpolation(input, weight, name=None):
+    """w*a + (1-w)*b with per-sample scalar w (reference:
+    InterpolationLayer)."""
+    a, b = _listify(input)
+    node = Layer("interpolation", parents=[a, b, weight], name=name)
+
+    def build(ctx):
+        w = weight.to_var(ctx)
+        av, bv = a.to_var(ctx), b.to_var(ctx)
+        return F.elementwise_add(
+            F.elementwise_mul(av, w),
+            F.elementwise_mul(bv, F.scale(w, scale=-1.0, bias=1.0)))
+
+    node._build = build
+    return node
+
+
+def scaling(weight, input, name=None):
+    """Row-wise scale of input by a per-sample scalar (reference:
+    ScalingLayer)."""
+    node = Layer("scaling", parents=[weight, input], name=name)
+    node._build = lambda ctx: F.elementwise_mul(input.to_var(ctx),
+                                                weight.to_var(ctx))
+    return node
+
+
+def scale_shift(input, param_attr=None, bias_attr=None, name=None):
+    """y = w*x + b with SCALAR learnable w, b (reference:
+    ScaleShiftLayer)."""
+    (inp,) = _listify(input)
+    node = Layer("scale_shift", parents=[inp], name=name)
+
+    def build(ctx):
+        w = _param([1], f"{node.name}.w0")
+        b = _param([1], f"{node.name}.wbias")
+        return F.elementwise_add(
+            F.elementwise_mul(inp.to_var(ctx), w), b)
+
+    node._build = build
+    return node
+
+
+def slope_intercept(input, slope=1.0, intercept=0.0, name=None):
+    (inp,) = _listify(input)
+    node = Layer("slope_intercept", parents=[inp], name=name)
+    node._build = lambda ctx: F.scale(inp.to_var(ctx),
+                                      scale=float(slope),
+                                      bias=float(intercept))
+    return node
+
+
+def power(input, weight, name=None):
+    """x ** w with per-sample scalar exponent (reference: PowerLayer).
+    Realized as exp(w * log(x)) so the exponent can be a tensor —
+    requires x > 0, as the reference's layer does in practice."""
+    node = Layer("power", parents=[input, weight], name=name)
+
+    def build(ctx):
+        x = input.to_var(ctx)
+        w = weight.to_var(ctx)
+        return F.exp(F.elementwise_mul(F.log(x), w))
+
+    node._build = build
+    return node
+
+
+def trans(input, name=None):
+    """Transpose the whole minibatch matrix (reference: TransLayer)."""
+    (inp,) = _listify(input)
+    node = Layer("trans", parents=[inp], name=name)
+    node._build = lambda ctx: F.transpose(inp.to_var(ctx), [1, 0])
+    return node
+
+
+def tensor_layer(a, b, size, param_attr=None, bias_attr=None, act=None,
+                 name=None, **_kw):
+    """out_k = a . W_k . b^T (reference: TensorLayer ->
+    bilinear_tensor_product_op)."""
+    node = Layer("tensor", parents=[a, b], name=name, size=size)
+
+    def build(ctx):
+        av, bv = a.to_var(ctx), b.to_var(ctx)
+        da, db = int(av.shape[-1]), int(bv.shape[-1])
+        w = _param([size, da, db], f"{node.name}.w0")
+        bias = _param([1, size], f"{node.name}.wbias")
+        out = _raw_op("bilinear_tensor_product",
+                      {"X": av, "Y": bv, "Weight": w, "Bias": bias})
+        return _apply_act(out["Out"], act)
+
+    node._build = build
+    return node
+
+
+def selective_fc(input, select, size, act=None, param_attr=None,
+                 bias_attr=None, name=None, **_kw):
+    """fc whose output is masked by a per-sample 0/1 selection matrix
+    (reference: SelectiveFullyConnectedLayer)."""
+    inputs = _listify(input)
+    node = Layer("selective_fc", parents=inputs + [select], name=name,
+                 size=size)
+
+    def build(ctx):
+        dense = fc(inputs, size, act=act, param_attr=param_attr,
+                   bias_attr=bias_attr, name=f"{node.name}_fc")
+        return F.elementwise_mul(dense.to_var(ctx), select.to_var(ctx))
+
+    node._build = build
+    return node
+
+
+def factorization_machine(input, factor_size, param_attr=None,
+                          name=None, **_kw):
+    """Second-order FM term: 0.5 * sum_k[(x.V_k)^2 - (x^2).(V_k^2)]
+    (reference: FactorizationMachineLayer.cpp)."""
+    (inp,) = _listify(input)
+    node = Layer("factorization_machine", parents=[inp], name=name)
+
+    def build(ctx):
+        x = inp.to_var(ctx)
+        d = int(x.shape[-1])
+        v = _param([d, factor_size], f"{node.name}.w0")
+        sum_sq = F.square(F.matmul(x, v))              # (x.V)^2
+        sq_sum = F.matmul(F.square(x), F.square(v))     # (x^2).(V^2)
+        return F.scale(F.reduce_sum(
+            F.elementwise_sub(sum_sq, sq_sum), dim=-1, keep_dim=True),
+            scale=0.5)
+
+    node._build = build
+    return node
+
+
+def data_norm(input, name=None, **_kw):
+    """Normalization by learned-then-frozen per-feature stats
+    (reference: DataNormLayer; z-score form)."""
+    (inp,) = _listify(input)
+    node = Layer("data_norm", parents=[inp], name=name)
+
+    def build(ctx):
+        x = inp.to_var(ctx)
+        d = int(x.shape[-1])
+        from ..initializer import ConstantInitializer
+        mean = _param([d], f"{node.name}.mean")
+        std = _param([d], f"{node.name}.std",
+                     initializer=ConstantInitializer(1.0))
+        return F.elementwise_div(F.elementwise_sub(x, mean), std)
+
+    node._build = build
+    return node
+
+
+# ---------------------------------------------------------------------
+# sequence layers
+# ---------------------------------------------------------------------
+
+def seq_concat(a, b, name=None):
+    node = Layer("seqconcat", parents=[a, b], name=name)
+    node._build = lambda ctx: _raw_op(
+        "sequence_concat", {"X": [a.to_var(ctx), b.to_var(ctx)]},
+        lod_out=("Out",))["Out"]
+    return node
+
+
+def seq_slice(input, offsets, sizes, name=None):
+    node = Layer("seq_slice", parents=[input, offsets, sizes], name=name)
+    node._build = lambda ctx: _raw_op(
+        "sequence_slice", {"X": input.to_var(ctx),
+                           "Offset": offsets.to_var(ctx),
+                           "Length": sizes.to_var(ctx)},
+        lod_out=("Out",))["Out"]
+    return node
+
+
+def sub_seq(input, offsets, sizes, name=None):
+    """reference: SubSequenceLayer — same contract as seq_slice."""
+    node = seq_slice(input, offsets, sizes, name=name)
+    node.type = "subseq"
+    return node
+
+
+def seq_reshape(input, reshape_size, name=None):
+    node = Layer("seqreshape", parents=[input], name=name,
+                 size=reshape_size)
+    node._build = lambda ctx: F.sequence_reshape(input.to_var(ctx),
+                                                 reshape_size)
+    return node
+
+
+def sub_nested_seq(input, name=None):
+    """Flatten the outer nesting level of a 2-level sequence
+    (reference: SubNestedSequenceLayer's underlying access pattern)."""
+    (inp,) = _listify(input)
+    node = Layer("sub_nested_seq", parents=[inp], name=name)
+    node._build = lambda ctx: _raw_op(
+        "nested_sequence_flatten", {"X": inp.to_var(ctx)},
+        lod_out=("Out",))["Out"]
+    return node
+
+
+def kmax_seq_score(input, beam_size=1, name=None):
+    """Indices of the k max scores (reference: KmaxSeqScoreLayer)."""
+    (inp,) = _listify(input)
+    node = Layer("kmax_seq_score", parents=[inp], name=name)
+
+    def build(ctx):
+        outs = _raw_op("top_k", {"X": inp.to_var(ctx)},
+                       attrs={"k": beam_size},
+                       out_slots=("Out", "Indices"))
+        return outs["Indices"]
+
+    node._build = build
+    return node
+
+
+def eos(input, eos_id, name=None):
+    """1.0 where the input id equals end-of-sequence (reference:
+    EosIdCheckLayer, type 'eos_id')."""
+    (inp,) = _listify(input)
+    node = Layer("eos_id", parents=[inp], name=name)
+
+    def build(ctx):
+        x = inp.to_var(ctx)
+        ref = F.fill_constant_batch_size_like(x, list(x.shape), "int64",
+                                              eos_id)
+        return F.cast(F.equal(x, ref), "float32")
+
+    node._build = build
+    return node
+
+
+def mdlstmemory(input, size, height, width, name=None, param_attr=None,
+                **_kw):
+    """2-D multi-dimensional LSTM (reference: MDLstmLayer). The input
+    carries 5*size gate pre-activations per grid cell."""
+    (inp,) = _listify(input)
+    node = Layer("mdlstmemory", parents=[inp], name=name, size=size)
+
+    def build(ctx):
+        x = F.reshape(inp.to_var(ctx), [-1, height, width, 5 * size])
+        wl = _param([size, 5 * size], f"{node.name}.wl")
+        wt = _param([size, 5 * size], f"{node.name}.wt")
+        out = _raw_op("mdlstm", {"X": x, "WeightLeft": wl,
+                                 "WeightTop": wt})["Out"]
+        return F.reshape(out, [-1, height * width * size])
+
+    node._build = build
+    return node
+
+
+def lstm_step(input, state, name=None, act=None, gate_act=None,
+              state_act=None, **_kw):
+    """One LSTM cell update from precomputed gate pre-activations
+    [bs, 4h] and the previous cell state [bs, h] (reference:
+    LstmStepLayer: the recurrent projection already lives in `input`).
+    The new cell state is exposed for get_output(..., 'state').
+    gate_act gates i/f/o, act squashes the candidate, state_act
+    squashes the cell on the way out (reference defaults)."""
+    node = Layer("lstm_step", parents=[input, state], name=name)
+
+    def _act(var, which, default):
+        nm = act_name(which)
+        fn = getattr(F, nm, None) if nm else None
+        return fn(var) if fn else default(var)
+
+    def build(ctx):
+        x = input.to_var(ctx)
+        c_prev = state.to_var(ctx)
+        h4 = int(x.shape[-1])
+        h = h4 // 4
+        i, f, g, o = (F.slice(x, [1], [k * h], [(k + 1) * h])
+                      for k in range(4))
+        c_new = F.elementwise_add(
+            F.elementwise_mul(_act(f, gate_act, F.sigmoid), c_prev),
+            F.elementwise_mul(_act(i, gate_act, F.sigmoid),
+                              _act(g, act, F.tanh)))
+        hid = F.elementwise_mul(_act(o, gate_act, F.sigmoid),
+                                _act(c_new, state_act, F.tanh))
+        ctx[(id(node), "state")] = c_new
+        return hid
+
+    node._build = build
+    return node
+
+
+def gru_step(input, output_mem, size=None, act=None, gate_act=None,
+             name=None, param_attr=None, bias_attr=None, **_kw):
+    """One GRU cell update (reference: GruStepLayer -> gru_unit)."""
+    node = Layer("gru_step", parents=[input, output_mem], name=name)
+
+    def build(ctx):
+        x = input.to_var(ctx)
+        prev = output_mem.to_var(ctx)
+        sz = size or int(prev.shape[-1])
+        hidden, _, _ = F.gru_unit(
+            x, prev, sz * 3,
+            param_attr=_pattr(param_attr, f"{node.name}.w0"),
+            activation=act_name(act) or "tanh",
+            gate_activation=act_name(gate_act) or "sigmoid")
+        return hidden
+
+    node._build = build
+    return node
+
+
+def get_output(input, arg_name, name=None):
+    """Select a named secondary output of a layer (reference:
+    GetOutputLayer; e.g. the 'state' of an lstm_step)."""
+    node = Layer("get_output", parents=[input], name=name)
+
+    def build(ctx):
+        input.to_var(ctx)  # ensure the parent has built its outputs
+        key = (id(input), arg_name)
+        if key not in ctx:
+            raise ValueError(
+                f"layer {input.name!r} exposes no output {arg_name!r}")
+        return ctx[key]
+
+    node._build = build
+    return node
+
+
+# ---------------------------------------------------------------------
+# recurrent groups (reference: trainer_config_helpers recurrent_group +
+# memory; the agent/gather_agent/scatter_agent/recurrent_layer_group
+# machinery the config parser emits for them)
+# ---------------------------------------------------------------------
+
+class StaticInput:
+    """Non-sequence input visible unchanged at every step."""
+
+    def __init__(self, input, is_seq=False, size=None):
+        self.input = input
+        self.is_seq = is_seq
+        self.size = size
+
+
+_RNN_STACK: List[dict] = []
+
+
+def _in_parent_block(build_fn, ctx):
+    """Build a sub-graph in the PARENT of the current block: vars
+    consumed by the outer dynamic_rnn op (memory boots, static inputs)
+    must have their producing ops outside the step sub-block."""
+    from ..framework import default_main_program
+    prog = default_main_program()
+    saved = prog._current_block_idx
+    prog._current_block_idx = prog.current_block().desc.parent_idx
+    try:
+        return build_fn(ctx)
+    finally:
+        prog._current_block_idx = saved
+
+
+def memory(name, size, boot_layer=None, **_kw):
+    """Declare a step memory linked BY NAME to the layer that produces
+    its next value inside the step (reference: memory() in
+    trainer_config_helpers; the 'agent'/'scatter_agent' plumbing)."""
+    node = Layer("memory")
+    node.link_name = name
+    node.size = size
+
+    def build(ctx):
+        if not _RNN_STACK:
+            raise ValueError("memory() is only usable inside a "
+                             "recurrent_group step function")
+        frame = _RNN_STACK[-1]
+        # boot graphs belong to the block OUTSIDE the scan
+        init = _in_parent_block(boot_layer.to_var, ctx) \
+            if boot_layer is not None else None
+        mem = frame["drnn"].memory(init=init, shape=[size])
+        frame["memories"].append((name, mem))
+        return mem
+
+    node._build = build
+    return node
+
+
+def recurrent_group(step, input, reverse=False, name=None):
+    """Run `step` over each timestep of the sequence inputs
+    (reference: recurrent_group -> RecurrentLayerGroup; realized on
+    the DynamicRNN masked scan). `step` receives one node per input
+    (step slice for sequences, the unchanged var for StaticInput) and
+    returns the step's output layer; memories declared via memory()
+    are linked to same-named layers in the step graph. reverse=True
+    runs right-to-left (sequence_reverse in, sequence_reverse out)."""
+    inputs = _listify(input)
+    parents = [i.input if isinstance(i, StaticInput) else i
+               for i in inputs]
+    node = Layer("recurrent_layer_group", parents=parents, name=name)
+
+    def build(ctx):
+        # resolve EVERY input graph before entering the step block —
+        # ops built inside drnn.block() land in the sub-block and the
+        # outer dynamic_rnn op could not see their results
+        resolved = []
+        for i in inputs:
+            if isinstance(i, StaticInput):
+                resolved.append(("static", i.input.to_var(ctx)))
+            else:
+                v = i.to_var(ctx)
+                if reverse:
+                    v = F.sequence_reverse(v)
+                resolved.append(("seq", v))
+        drnn = F.DynamicRNN()
+        frame = {"drnn": drnn, "memories": []}
+        with drnn.block():
+            args = []
+            for kind, v in resolved:
+                sv = drnn.static_input(v) if kind == "static" \
+                    else drnn.step_input(v)
+                wrap = Layer("agent")
+                wrap._build = (lambda _ctx, _v=sv: _v)
+                args.append(wrap)
+            _RNN_STACK.append(frame)
+            try:
+                out_node = step(*args)
+                if isinstance(out_node, (list, tuple)):
+                    raise NotImplementedError(
+                        "multi-output recurrent_group: return a single "
+                        "layer (concat inside the step if needed)")
+                out_var = out_node.to_var(ctx)
+                for link_name, mem_var in frame["memories"]:
+                    target = None
+                    for n in out_node.ancestors():
+                        if n.name == link_name:
+                            target = n
+                    if target is None:
+                        raise ValueError(
+                            f"memory {link_name!r}: no layer of that "
+                            "name in the step graph")
+                    drnn.update_memory(mem_var, target.to_var(ctx))
+                drnn.output(out_var)
+            finally:
+                _RNN_STACK.pop()
+        out = drnn()
+        return F.sequence_reverse(out) if reverse else out
+
+    node._build = build
+    return node
+
+
+def recurrent(input, act=None, reverse=False, name=None,
+              param_attr=None, **_kw):
+    """Simple full-matrix recurrence h_t = act(x_t + h_{t-1} W)
+    (reference: RecurrentLayer, type 'recurrent'). reverse=True scans
+    right-to-left via sequence_reverse on both sides."""
+    (inp,) = _listify(input)
+    node = Layer("recurrent", parents=[inp], name=name)
+
+    def build(ctx):
+        x = inp.to_var(ctx)
+        if reverse:
+            x = F.sequence_reverse(x)
+        d = int(x.shape[-1])
+        drnn = F.DynamicRNN()
+        with drnn.block():
+            step = drnn.step_input(x)
+            prev = drnn.memory(shape=[d], value=0.0)
+            proj = F.fc(prev, size=d, bias_attr=False,
+                        param_attr=_pattr(param_attr,
+                                          f"{node.name}.w0"))
+            h = _apply_act(F.elementwise_add(step, proj),
+                           act) if act else F.tanh(
+                F.elementwise_add(step, proj))
+            drnn.update_memory(prev, h)
+            drnn.output(h)
+        out = drnn()
+        return F.sequence_reverse(out) if reverse else out
+
+    node._build = build
+    return node
+
+
+# ---------------------------------------------------------------------
+# output / decode layers
+# ---------------------------------------------------------------------
+
+def multiplex(input, name=None):
+    """input = [index_layer, candidate0, candidate1, ...]; picks row i
+    from candidate[index[i]] (reference: MultiplexLayer)."""
+    nodes = _listify(input)
+    node = Layer("multiplex", parents=nodes, name=name)
+
+    def build(ctx):
+        ids = nodes[0].to_var(ctx)
+        xs = [n.to_var(ctx) for n in nodes[1:]]
+        return _raw_op("multiplex", {"Ids": ids, "X": xs})["Out"]
+
+    node._build = build
+    return node
+
+
+def sampling_id(input, name=None):
+    (inp,) = _listify(input)
+    node = Layer("sampling_id", parents=[inp], name=name)
+    node._build = lambda ctx: _raw_op(
+        "sampling_id", {"X": inp.to_var(ctx)}, dtype="int64")["Out"]
+    return node
+
+
+def print_layer(input, message="", name=None):
+    (inp,) = _listify(input)
+    node = Layer("print", parents=[inp], name=name)
+    node._build = lambda ctx: _raw_op(
+        "print", {"X": inp.to_var(ctx)},
+        attrs={"message": message or node.name})["Out"]
+    return node
+
+
+def row_l2_norm(input, name=None):
+    (inp,) = _listify(input)
+    node = Layer("row_l2_norm", parents=[inp], name=name)
+    node._build = lambda ctx: F.l2_normalize(inp.to_var(ctx), axis=1)
+    return node
+
+
+def row_conv(input, context_len, param_attr=None, act=None, name=None):
+    (inp,) = _listify(input)
+    node = Layer("row_conv", parents=[inp], name=name)
+    node._build = lambda ctx: F.row_conv(
+        inp.to_var(ctx), context_len,
+        param_attr=_pattr(param_attr, f"{node.name}.w0"),
+        act=act_name(act) or None)
+    return node
+
+
+def roi_pool(input, rois, pooled_width, pooled_height, spatial_scale,
+             num_channels=None, name=None):
+    node = Layer("roi_pool", parents=[input, rois], name=name)
+
+    def build(ctx):
+        var, _s = _image_of(input, input.to_var(ctx), num_channels)
+        return F.roi_pool(var, rois.to_var(ctx),
+                          pooled_height=pooled_height,
+                          pooled_width=pooled_width,
+                          spatial_scale=spatial_scale)
+
+    node._build = build
+    return node
+
+
+def priorbox(input, image, min_size, max_size=None, aspect_ratio=(1.0,),
+             variance=(0.1, 0.1, 0.2, 0.2), num_channels=None,
+             name=None):
+    """SSD prior boxes; the variances tensor is exposed for
+    get_output(.., 'variances') and consumed directly by
+    detection_output/multibox_loss (reference: PriorBoxLayer)."""
+    node = Layer("priorbox", parents=[input, image], name=name)
+
+    def build(ctx):
+        var, _s = _image_of(input, input.to_var(ctx), num_channels)
+        img, _si = _image_of(image, image.to_var(ctx), None)
+        boxes, variances = F.prior_box(
+            var, img, min_sizes=list(_listify(min_size)),
+            max_sizes=list(_listify(max_size)) if max_size else None,
+            aspect_ratios=tuple(aspect_ratio),
+            variance=tuple(variance))
+        b2 = F.reshape(boxes, [-1, 4])
+        ctx[(id(node), "variances")] = F.reshape(variances, [-1, 4])
+        return b2
+
+    node._build = build
+    return node
+
+
+def _prior_pair(ctx, pb):
+    boxes = pb.to_var(ctx)
+    return boxes, ctx[(id(pb), "variances")]
+
+
+def detection_output(input_loc, input_conf, priorbox, num_classes=2,
+                     name=None, **kw):
+    """reference: DetectionOutputLayer -> detection_output op. Flat
+    [bs, num_priors*4] loc and [bs, num_priors*C] conf inputs are
+    reshaped against the priorbox count."""
+    node = Layer("detection_output",
+                 parents=[input_loc, input_conf, priorbox], name=name)
+
+    def build(ctx):
+        boxes, pvar = _prior_pair(ctx, priorbox)
+        n_priors = int(boxes.shape[0])
+        loc = F.reshape(input_loc.to_var(ctx), [-1, n_priors, 4])
+        conf = F.reshape(input_conf.to_var(ctx),
+                         [-1, n_priors, num_classes])
+        return F.detection_output(loc, conf, boxes, pvar, **kw)
+
+    node._build = build
+    return node
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, **_kw):
+    (inp,) = _listify(input)
+    node = Layer("hsigmoid", parents=[inp, label], name=name)
+    node._build = lambda ctx: F.hsigmoid(
+        inp.to_var(ctx), label.to_var(ctx), num_classes,
+        param_attr=_pattr(param_attr, f"{node.name}.w0"))
+    return node
+
+
+def nce(input, label, num_classes, num_neg_samples=10, param_attr=None,
+        bias_attr=None, name=None, **_kw):
+    (inp,) = _listify(input)
+    node = Layer("nce", parents=[inp, label], name=name)
+    node._build = lambda ctx: F.nce(
+        inp.to_var(ctx), label.to_var(ctx), num_classes,
+        num_neg_samples=num_neg_samples,
+        param_attr=_pattr(param_attr, f"{node.name}.w0"))
+    return node
+
+
+# ---------------------------------------------------------------------
+# cost layers
+# ---------------------------------------------------------------------
+
+def crf(input, label, size=None, param_attr=None, name=None, **_kw):
+    """Linear-chain CRF negative log-likelihood (reference: CRFLayer
+    -> linear_chain_crf_op)."""
+    node = Layer("crf", parents=[input, label], name=name)
+    node._build = lambda ctx: F.mean(F.linear_chain_crf(
+        input.to_var(ctx), label.to_var(ctx),
+        param_attr=_pattr(param_attr, f"{node.name}.w0")))
+    return node
+
+
+def crf_decoding(input, size=None, label=None, param_attr=None,
+                 name=None, **_kw):
+    node = Layer("crf_decoding", parents=[input] + _listify(label),
+                 name=name)
+    node._build = lambda ctx: F.crf_decoding(
+        input.to_var(ctx),
+        param_attr=_pattr(param_attr, f"{node.name}.w0"),
+        label=label.to_var(ctx) if label is not None else None)
+    return node
+
+
+def ctc(input, label, size=None, blank=0, norm_by_times=False,
+        name=None, **_kw):
+    """CTC cost (reference: CTCLayer / warp_ctc)."""
+    node = Layer("ctc", parents=[input, label], name=name)
+    node._build = lambda ctx: F.mean(F.warpctc(
+        input.to_var(ctx), label.to_var(ctx), blank=blank,
+        norm_by_times=norm_by_times))
+    return node
+
+
+def warp_ctc(input, label, size=None, blank=0, norm_by_times=False,
+             name=None, **_kw):
+    node = ctc(input, label, size=size, blank=blank,
+               norm_by_times=norm_by_times, name=name)
+    node.type = "warp_ctc"
+    return node
+
+
+def hinge_loss_cost(input, label, name=None):
+    """reference: HuberTwoClassification sibling hinge family — kept
+    for completeness of the cost vocabulary."""
+    node = Layer("hinge_loss", parents=[input, label], name=name)
+    node._build = lambda ctx: F.mean(_raw_op(
+        "hinge_loss", {"Logits": input.to_var(ctx),
+                       "Labels": label.to_var(ctx)},
+        out_slots=("Loss",))["Loss"])
+    return node
+
+
+def huber_classification_cost(input, label, name=None, **_kw):
+    """Huber loss for binary classification (reference:
+    HuberTwoClassification)."""
+    node = Layer("huber_classification", parents=[input, label],
+                 name=name)
+    node._build = lambda ctx: F.mean(F.huber_loss(
+        input.to_var(ctx), label.to_var(ctx), delta=1.0))
+    return node
+
+
+def huber_regression_cost(input, label, delta=1.0, name=None, **_kw):
+    node = Layer("huber_regression", parents=[input, label], name=name)
+    node._build = lambda ctx: F.mean(F.smooth_l1(
+        input.to_var(ctx), label.to_var(ctx), sigma=1.0 / delta))
+    return node
+
+
+def smooth_l1_cost(input, label, name=None, **_kw):
+    node = Layer("smooth_l1", parents=[input, label], name=name)
+    node._build = lambda ctx: F.mean(F.smooth_l1(input.to_var(ctx),
+                                                 label.to_var(ctx)))
+    return node
+
+
+def multi_binary_label_cross_entropy(input, label, name=None, **_kw):
+    """Element-wise binary CE on sigmoid outputs (reference:
+    MultiBinaryLabelCrossEntropy; v2 convention: input is already
+    sigmoid-activated)."""
+    node = Layer("multi_binary_label_cross_entropy",
+                 parents=[input, label], name=name)
+
+    def build(ctx):
+        p = F.clip(input.to_var(ctx), 1e-7, 1.0 - 1e-7)
+        y = label.to_var(ctx)
+        pos = F.elementwise_mul(y, F.log(p))
+        neg = F.elementwise_mul(F.scale(y, scale=-1.0, bias=1.0),
+                                F.log(F.scale(p, scale=-1.0, bias=1.0)))
+        return F.mean(F.scale(F.elementwise_add(pos, neg), scale=-1.0))
+
+    node._build = build
+    return node
+
+
+def soft_binary_class_cross_entropy(input, label, name=None, **_kw):
+    node = multi_binary_label_cross_entropy(input, label, name=name)
+    node.type = "soft_binary_class_cross_entropy"
+    return node
+
+
+def multi_class_cross_entropy_with_selfnorm(
+        input, label, softmax_selfnorm_alpha=0.1, name=None, **_kw):
+    """CE + alpha * mean(log Z ^ 2) self-normalization penalty
+    (reference: MultiClassCrossEntropyWithSelfNorm); input is raw
+    logits here."""
+    node = Layer("multi_class_cross_entropy_with_selfnorm",
+                 parents=[input, label], name=name)
+
+    def build(ctx):
+        logits = input.to_var(ctx)
+        ce = F.mean(F.softmax_with_cross_entropy(logits,
+                                                 label.to_var(ctx)))
+        log_z = F.log(F.reduce_sum(F.exp(logits), dim=-1,
+                                   keep_dim=True))
+        return F.elementwise_add(
+            ce, F.scale(F.mean(F.square(log_z)),
+                        scale=float(softmax_selfnorm_alpha)))
+
+    node._build = build
+    return node
+
+
+def lambda_cost(input, score, NDCG_num=5, max_sort_size=-1, name=None):
+    """Pairwise learning-to-rank cost over padded per-query score
+    lists (reference: LambdaCost / LambdaRank). Pair (i, j) with
+    yi > yj contributes |2^yi - 2^yj| / idealDCG * log(1+exp(sj-si)),
+    where idealDCG sums the top-NDCG_num label gains at positions
+    1..NDCG_num — the reference's NDCG truncation, computed in-graph
+    via top_k instead of its host-side sort."""
+    node = Layer("lambda_cost", parents=[input, score], name=name)
+
+    def build(ctx):
+        s = input.to_var(ctx)     # [bs, L] model scores
+        y = score.to_var(ctx)     # [bs, L] relevance labels
+        l = int(s.shape[-1])
+        k = max(1, min(NDCG_num, l))
+        s_i = F.reshape(s, [-1, l, 1])
+        s_j = F.reshape(s, [-1, 1, l])
+        y_i = F.reshape(y, [-1, l, 1])
+        y_j = F.reshape(y, [-1, 1, l])
+        # log(1 + exp(-(si - sj))) for pairs with yi > yj
+        diff = F.elementwise_sub(s_i, s_j)
+        pair_loss = F.log(F.scale(F.exp(F.scale(diff, scale=-1.0)),
+                                  bias=1.0))
+        ln2 = float(np.log(2.0))
+        gain = F.abs(F.elementwise_sub(
+            F.exp(F.scale(y_i, scale=ln2)),
+            F.exp(F.scale(y_j, scale=ln2))))
+        order = F.cast(F.greater_than(y_i, y_j), "float32")
+        weighted = F.elementwise_mul(F.elementwise_mul(pair_loss, gain),
+                                     order)
+        # ideal DCG over the top-k labels: sum (2^y - 1) / log2(pos+2)
+        y_top = _raw_op("top_k", {"X": y}, attrs={"k": k},
+                        out_slots=("Out", "Indices"))["Out"]
+        disc = F.assign(np.asarray(
+            [1.0 / np.log2(p + 2.0) for p in range(k)], np.float32))
+        idcg = F.reduce_sum(F.elementwise_mul(
+            F.scale(F.exp(F.scale(y_top, scale=ln2)), bias=-1.0), disc),
+            dim=-1, keep_dim=True)
+        per_query = F.elementwise_div(
+            F.reduce_sum(weighted, dim=[1, 2], keep_dim=False),
+            F.scale(F.reshape(idcg, [-1]), bias=1e-6))
+        return F.mean(per_query)
+
+    node._build = build
+    return node
+
+
+def cross_entropy_over_beam(input, label, name=None, **_kw):
+    """Beam-level cross-entropy: -log softmax over candidate scores at
+    the gold index (reference: CrossEntropyOverBeam — realized on the
+    padded per-sample beam-score matrix; the reference's multi-pass
+    beam expansion is subsumed by beam_search + this cost)."""
+    node = Layer("cross_entropy_over_beam", parents=[input, label],
+                 name=name)
+    node._build = lambda ctx: F.mean(F.softmax_with_cross_entropy(
+        input.to_var(ctx), label.to_var(ctx)))
+    return node
+
+
+def multibox_loss(input_loc, input_conf, priorbox, label_box,
+                  label_class, num_classes=2, name=None, **kw):
+    """SSD MultiBox loss (reference: MultiBoxLossLayer -> ssd_loss).
+    Flat v2 inputs are reshaped against the priorbox count: loc
+    [bs, P*4], conf [bs, P*C], gt boxes [bs, G*4], gt labels [bs, G]."""
+    node = Layer("multibox_loss",
+                 parents=[input_loc, input_conf, priorbox,
+                          label_box, label_class], name=name)
+
+    def build(ctx):
+        boxes, pvar = _prior_pair(ctx, priorbox)
+        n_priors = int(boxes.shape[0])
+        loc = F.reshape(input_loc.to_var(ctx), [-1, n_priors, 4])
+        conf = F.reshape(input_conf.to_var(ctx),
+                         [-1, n_priors, num_classes])
+        gt_flat = label_box.to_var(ctx)
+        n_gt = int(gt_flat.shape[-1]) // 4
+        gt = F.reshape(gt_flat, [-1, n_gt, 4])
+        gl = F.reshape(label_class.to_var(ctx), [-1, n_gt])
+        return F.mean(F.ssd_loss(loc, conf, gt, gl, boxes, pvar, **kw))
+
+    node._build = build
+    return node
+
+
+def sum_cost(input, name=None):
+    (inp,) = _listify(input)
+    node = Layer("sum_cost", parents=[inp], name=name)
+    node._build = lambda ctx: F.reduce_sum(inp.to_var(ctx))
+    return node
+
+
+# ---------------------------------------------------------------------
+# the full 103-type vocabulary -> runnable constructor map (audited by
+# tests/test_v2_layer_types_runnable.py; reference REGISTER_LAYER names)
+# ---------------------------------------------------------------------
+
+LAYER_TYPE_CONSTRUCTORS = {
+    "addto": addto, "agent": recurrent_group, "average": pooling,
+    "batch_norm": batch_norm, "bilinear_interp": bilinear_interp,
+    "blockexpand": block_expand, "clip": clip_layer, "concat": concat,
+    "concat2": concat, "conv3d": conv3d, "conv_shift": conv_shift,
+    "convex_comb": linear_comb, "cos": cos_sim, "cos_vm": cos_sim,
+    "crf": crf, "crf_decoding": crf_decoding, "crop": crop,
+    "cross_entropy_over_beam": cross_entropy_over_beam, "ctc": ctc,
+    "cudnn_batch_norm": batch_norm, "cudnn_conv": img_conv,
+    "cudnn_convt": img_conv, "data": data, "data_norm": data_norm,
+    "deconv3d": deconv3d, "detection_output": detection_output,
+    "dot_prod": dot_prod, "eos_id": eos, "exconv": img_conv,
+    "exconvt": img_conv, "expand": expand,
+    "factorization_machine": factorization_machine, "fc": fc,
+    "featmap_expand": expand, "gated_recurrent": gru,
+    "gather_agent": recurrent_group, "get_output": get_output,
+    "gru_step": gru_step, "hsigmoid": hsigmoid,
+    "huber_classification": huber_classification_cost,
+    "huber_regression": huber_regression_cost,
+    "interpolation": interpolation, "kmax_seq_score": kmax_seq_score,
+    "l2_distance": l2_distance, "lambda_cost": lambda_cost,
+    "lstm_step": lstm_step, "lstmemory": lstmemory, "max": pooling,
+    "maxid": max_id, "maxout": maxout, "mdlstmemory": mdlstmemory,
+    "mixed": mixed, "mkl_packed_recurrent": recurrent,
+    "mkldnn_addto": addto, "mkldnn_batch_norm": batch_norm,
+    "mkldnn_concat": concat, "mkldnn_conv": img_conv,
+    "mkldnn_fc": fc, "mkldnn_lrn": img_cmrnorm,
+    "mkldnn_pool": img_pool,
+    "multi_binary_label_cross_entropy": multi_binary_label_cross_entropy,
+    "multi_class_cross_entropy_with_selfnorm":
+        multi_class_cross_entropy_with_selfnorm,
+    "multibox_loss": multibox_loss, "multiplex": multiplex, "nce": nce,
+    "out_prod": out_prod, "pad": pad, "pool3d": pool3d,
+    "power": power, "prelu": prelu, "print": print_layer,
+    "priorbox": priorbox, "recurrent": recurrent,
+    "recurrent_layer_group": recurrent_group, "resize": resize,
+    "roi_pool": roi_pool, "rotate": rotate, "row_conv": row_conv,
+    "row_l2_norm": row_l2_norm, "sampling_id": sampling_id,
+    "scale_shift": scale_shift,
+    "scale_sub_region": scale_sub_region, "scaling": scaling,
+    "scatter_agent": recurrent_group, "selective_fc": selective_fc,
+    "seq_slice": seq_slice, "seqconcat": seq_concat,
+    "seqlastins": last_seq, "seqreshape": seq_reshape,
+    "slope_intercept": slope_intercept, "smooth_l1": smooth_l1_cost,
+    "soft_binary_class_cross_entropy": soft_binary_class_cross_entropy,
+    "spp": spp, "square_error": square_error_cost,
+    "sub_nested_seq": sub_nested_seq, "subseq": sub_seq,
+    "sum_cost": sum_cost, "sum_to_one_norm": sum_to_one_norm,
+    "switch_order": switch_order, "tensor": tensor_layer,
+    "trans": trans, "upsample": upsample, "warp_ctc": warp_ctc,
+}
+
+
+# ---------------------------------------------------------------------
 # parse_network — the reference returns the emitted ModelConfig proto;
 # here the equivalent artifact is a summary of the lowered Program.
 # ---------------------------------------------------------------------
@@ -552,4 +1744,24 @@ __all__ = [
     "first_seq", "lstmemory", "gru", "grumemory", "expand",
     "classification_cost", "cross_entropy_cost", "square_error_cost",
     "mse_cost", "regression_cost", "parse_network",
+    # full-vocabulary constructors (round 5)
+    "bilinear_interp", "block_expand", "clip_layer", "conv3d",
+    "deconv3d", "pad", "pool3d", "rotate", "switch_order", "crop",
+    "upsample", "resize", "scale_sub_region", "prelu", "mixed",
+    "dot_prod", "out_prod", "l2_distance", "linear_comb",
+    "interpolation", "scaling", "scale_shift", "slope_intercept",
+    "power", "trans", "tensor_layer", "selective_fc",
+    "factorization_machine", "data_norm", "seq_concat", "seq_slice",
+    "sub_seq", "seq_reshape", "sub_nested_seq", "kmax_seq_score",
+    "eos", "mdlstmemory", "lstm_step", "gru_step", "get_output",
+    "StaticInput", "memory", "recurrent_group", "recurrent",
+    "multiplex", "sampling_id", "print_layer", "row_l2_norm",
+    "row_conv", "roi_pool", "priorbox", "detection_output",
+    "hsigmoid", "nce", "crf", "crf_decoding", "ctc", "warp_ctc",
+    "huber_classification_cost", "huber_regression_cost",
+    "smooth_l1_cost", "multi_binary_label_cross_entropy",
+    "soft_binary_class_cross_entropy",
+    "multi_class_cross_entropy_with_selfnorm", "lambda_cost",
+    "cross_entropy_over_beam", "multibox_loss", "sum_cost",
+    "hinge_loss_cost", "LAYER_TYPE_CONSTRUCTORS",
 ]
