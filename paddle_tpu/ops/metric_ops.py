@@ -135,7 +135,8 @@ def _edit_distance(ctx):
     ctx.set_output("SequenceNum", jnp.asarray(hd.shape[0], jnp.int64))
 
 
-@register_op("chunk_eval", no_grad_slots=["Inference", "Label"])
+@register_op("chunk_eval", no_grad_slots=["Inference", "Label"],
+             ragged_aware=True)
 def _chunk_eval(ctx):
     """Chunking (NER-style) precision/recall/F1 over IOB-tagged ragged
     sequences (reference: chunk_eval_op.cc). Tags encode
@@ -147,9 +148,12 @@ def _chunk_eval(ctx):
     lab = ctx.input("Label")
     num_chunk_types = ctx.attr("num_chunk_types")
     scheme = ctx.attr("chunk_scheme", "IOB")
-    if scheme != "IOB":
-        raise NotImplementedError("chunk_eval: only IOB scheme (the "
-                                  "reference default) is implemented")
+    # tag layouts per scheme (reference ChunkEvaluator.cpp:79-107):
+    #   plain: 1 tag; IOB: B=0 I=1; IOE: I=0 E=1; IOBES: B I E S = 0..3
+    num_tag_by_scheme = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}
+    if scheme not in num_tag_by_scheme:
+        raise ValueError(f"chunk_eval: unknown scheme {scheme!r} "
+                         f"(one of {sorted(num_tag_by_scheme)})")
     from ..core.lod import RaggedPair as _RP
     if isinstance(inf, _RP):
         mask, inf, lab = inf.mask(), inf.data, lab.data
@@ -157,12 +161,16 @@ def _chunk_eval(ctx):
         mask = jnp.ones(inf.shape[:2], bool)
     if inf.ndim == 3:
         inf, lab = inf[..., 0], lab[..., 0]
-    num_tag = 2  # IOB: B, I
+    num_tag = num_tag_by_scheme[scheme]
 
     excluded = [int(t) for t in (ctx.attr("excluded_chunk_types") or [])]
 
     def chunks(tags):
-        """begin/inside flags + chunk id per position."""
+        """Per-position begin/outside flags + chunk type. Every
+        non-Other position belongs to some chunk (the reference's
+        isChunkBegin returns True whenever prev is Other or the type
+        changes), so only the same-type begin rule is scheme-specific
+        (reference isChunkBegin, ChunkEvaluator.cpp:235-245)."""
         ctype = tags // num_tag
         pos = tags % num_tag
         outside = (tags < 0) | (tags >= num_chunk_types * num_tag)
@@ -170,9 +178,21 @@ def _chunk_eval(ctx):
             outside = outside | (ctype == ex)
         prev_t = jnp.concatenate(
             [jnp.full_like(ctype[:, :1], -1), ctype[:, :-1]], axis=1)
+        prev_pos = jnp.concatenate(
+            [jnp.zeros_like(pos[:, :1]), pos[:, :-1]], axis=1)
         prev_out = jnp.concatenate(
             [jnp.ones_like(outside[:, :1]), outside[:, :-1]], axis=1)
-        begin = ~outside & ((pos == 0) | prev_out | (ctype != prev_t))
+        if scheme == "plain":        # same-type run = one chunk
+            same_begin = jnp.zeros_like(outside)
+        elif scheme == "IOB":        # new chunk at every B
+            same_begin = pos == 0
+        elif scheme == "IOE":        # new chunk right after an E
+            same_begin = prev_pos == 1
+        else:                        # IOBES
+            same_begin = (pos == 0) | (pos == 3) | \
+                (((pos == 1) | (pos == 2)) &
+                 ((prev_pos == 2) | (prev_pos == 3)))
+        begin = ~outside & (prev_out | (ctype != prev_t) | same_begin)
         return begin & mask, outside | ~mask, ctype
 
     b_i, o_i, t_i = chunks(inf)

@@ -547,8 +547,14 @@ def _raw_op(op_type, inputs, attrs=None, out_slots=("Out",),
     return outs
 
 
-def _param(shape, name, initializer=None):
-    return F.create_parameter(list(shape), "float32", name=name,
+def _param(shape, attr_or_name, initializer=None):
+    """Create a parameter from either a resolved ParamAttr (so user
+    initializers/regularizers/shared names are honored) or a default
+    name string."""
+    if isinstance(attr_or_name, str):
+        attr_or_name = ParamAttr(name=attr_or_name)
+    return F.create_parameter(list(shape), "float32",
+                              attr=attr_or_name,
                               default_initializer=initializer)
 
 
@@ -619,7 +625,8 @@ def conv3d(input, filter_size, num_filters, num_channels=None,
             else (filter_size,) * 3
         # transpose conv keeps the reference's [Cin, Cout, ...] layout
         fshape = [cin, num_filters] if trans else [num_filters, cin]
-        w = _param(fshape + list(k), f"{node.name}.w0")
+        w = _param(fshape + list(k),
+                   _pattr(param_attr, f"{node.name}.w0"))
         out = _raw_op("conv3d_transpose" if trans else "conv3d",
                       {"Input": var, "Filter": w},
                       attrs={"strides": [stride] * 3,
@@ -627,6 +634,13 @@ def conv3d(input, filter_size, num_filters, num_channels=None,
                              "dilations": [1, 1, 1],
                              **({} if trans else {"groups": 1})},
                       out_slots=("Output",))["Output"]
+        if bias_attr is not False:
+            b = F.create_parameter(
+                [num_filters], "float32",
+                attr=_pattr(bias_attr, f"{node.name}.wbias"),
+                is_bias=True)
+            out = F.elementwise_add(out, F.reshape(
+                b, [1, num_filters, 1, 1, 1]))
         return _apply_act(out, act)
 
     node._build = build
@@ -869,8 +883,8 @@ def scale_shift(input, param_attr=None, bias_attr=None, name=None):
     node = Layer("scale_shift", parents=[inp], name=name)
 
     def build(ctx):
-        w = _param([1], f"{node.name}.w0")
-        b = _param([1], f"{node.name}.wbias")
+        w = _param([1], _pattr(param_attr, f"{node.name}.w0"))
+        b = _param([1], _pattr(bias_attr, f"{node.name}.wbias"))
         return F.elementwise_add(
             F.elementwise_mul(inp.to_var(ctx), w), b)
 
@@ -919,8 +933,9 @@ def tensor_layer(a, b, size, param_attr=None, bias_attr=None, act=None,
     def build(ctx):
         av, bv = a.to_var(ctx), b.to_var(ctx)
         da, db = int(av.shape[-1]), int(bv.shape[-1])
-        w = _param([size, da, db], f"{node.name}.w0")
-        bias = _param([1, size], f"{node.name}.wbias")
+        w = _param([size, da, db],
+                   _pattr(param_attr, f"{node.name}.w0"))
+        bias = _param([1, size], _pattr(bias_attr, f"{node.name}.wbias"))
         out = _raw_op("bilinear_tensor_product",
                       {"X": av, "Y": bv, "Weight": w, "Bias": bias})
         return _apply_act(out["Out"], act)
@@ -956,7 +971,8 @@ def factorization_machine(input, factor_size, param_attr=None,
     def build(ctx):
         x = inp.to_var(ctx)
         d = int(x.shape[-1])
-        v = _param([d, factor_size], f"{node.name}.w0")
+        v = _param([d, factor_size],
+                   _pattr(param_attr, f"{node.name}.w0"))
         sum_sq = F.square(F.matmul(x, v))              # (x.V)^2
         sq_sum = F.matmul(F.square(x), F.square(v))     # (x^2).(V^2)
         return F.scale(F.reduce_sum(
@@ -1074,7 +1090,10 @@ def mdlstmemory(input, size, height, width, name=None, param_attr=None,
 
     def build(ctx):
         x = F.reshape(inp.to_var(ctx), [-1, height, width, 5 * size])
-        wl = _param([size, 5 * size], f"{node.name}.wl")
+        wl = _param([size, 5 * size],
+                    _pattr(param_attr, f"{node.name}.wl"))
+        # second recurrent weight keeps its own name (sharing a
+        # user-named attr across both would silently tie them)
         wt = _param([size, 5 * size], f"{node.name}.wt")
         out = _raw_op("mdlstm", {"X": x, "WeightLeft": wl,
                                  "WeightTop": wt})["Out"]
@@ -1501,19 +1520,40 @@ def hinge_loss_cost(input, label, name=None):
 
 
 def huber_classification_cost(input, label, name=None, **_kw):
-    """Huber loss for binary classification (reference:
-    HuberTwoClassification)."""
+    """Margin-based two-class Huber (reference:
+    HuberTwoClassification, CostLayer.cpp): with y = 2*label-1 and
+    z = y*f: 0 when z >= 1, (1-z)^2 when -1 < z < 1, -4z when
+    z <= -1 (continuous at z = -1)."""
     node = Layer("huber_classification", parents=[input, label],
                  name=name)
-    node._build = lambda ctx: F.mean(F.huber_loss(
-        input.to_var(ctx), label.to_var(ctx), delta=1.0))
+
+    def build(ctx):
+        f = input.to_var(ctx)
+        y = F.scale(label.to_var(ctx), scale=2.0, bias=-1.0)
+        z = F.elementwise_mul(y, f)
+        quad = F.square(F.relu(F.scale(z, scale=-1.0, bias=1.0)))
+        lin = F.scale(z, scale=-4.0)
+        neg_one = F.fill_constant_batch_size_like(z, list(z.shape),
+                                                  "float32", -1.0)
+        is_lin = F.cast(F.less_than(z, neg_one), "float32")
+        loss = F.elementwise_add(
+            F.elementwise_mul(is_lin, lin),
+            F.elementwise_mul(F.scale(is_lin, scale=-1.0, bias=1.0),
+                              quad))
+        return F.mean(loss)
+
+    node._build = build
     return node
 
 
 def huber_regression_cost(input, label, delta=1.0, name=None, **_kw):
+    """Huber regression with threshold `delta` (reference:
+    HuberRegressionLoss): 0.5 d^2 for |d| <= delta, else
+    delta*(|d| - 0.5*delta) — the huber_loss op implements exactly
+    this."""
     node = Layer("huber_regression", parents=[input, label], name=name)
-    node._build = lambda ctx: F.mean(F.smooth_l1(
-        input.to_var(ctx), label.to_var(ctx), sigma=1.0 / delta))
+    node._build = lambda ctx: F.mean(F.huber_loss(
+        input.to_var(ctx), label.to_var(ctx), delta=delta))
     return node
 
 

@@ -1,0 +1,254 @@
+"""Model-level gradient checking (reference: `paddle_trainer
+--job=checkgrad`, paddle/trainer/TrainerMain.cpp:55): check_gradients
+finite-difference-verifies every trainable parameter gradient of an
+arbitrary Program. The sweep drives compact builds of the 8 book
+models (reference: python/paddle/v2/fluid/tests/book/)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.lod import LoDTensor, RaggedPair
+from paddle_tpu.debug import check_gradients
+
+
+def _ragged(seqs, dtype="int64", feat=None):
+    arrs = [np.asarray(s, dtype).reshape(len(s), *(feat or []))
+            for s in seqs]
+    lod = LoDTensor.from_sequences(arrs)
+    padded, lengths = lod.to_padded(max_len=max(len(s) for s in seqs))
+    return RaggedPair(padded, lengths)
+
+
+def _check(loss, feed, **kw):
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    kw.setdefault("max_elements_per_param", 4)
+    report = check_gradients(loss, feed, **kw)
+    assert report, "no parameters checked"
+    return report
+
+
+def test_checkgrad_rejects_optimized_programs():
+    x = layers.data("x", [4, 3], append_batch_size=False)
+    y = layers.data("y", [4, 1], append_batch_size=False)
+    loss = layers.reduce_mean(
+        layers.square(layers.fc(x, size=1) - y))
+    pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    with pytest.raises(ValueError, match="optimizer ops"):
+        check_gradients(loss, {})
+
+
+def test_checkgrad_catches_a_wrong_gradient():
+    """Sanity that the checker can FAIL: a stop-gradient detour makes
+    the analytic grad of the detoured param zero while the numeric
+    one is not."""
+    x = layers.data("x", [4, 3], append_batch_size=False)
+    h = layers.fc(x, size=2, bias_attr=False)
+    loss = layers.reduce_mean(layers.square(h))
+    r = np.random.RandomState(0)
+    feed = {"x": r.uniform(0.5, 1.0, (4, 3)).astype(np.float32)}
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    # corrupt: zero out the analytic grad by checking a param that the
+    # loss genuinely depends on, against a DIFFERENT loss's backward —
+    # simplest robust corruption: check with eps so large the numeric
+    # side is nonlinear-dominated
+    rep = check_gradients(loss, feed, max_elements_per_param=4)
+    assert max(rep.values()) < 5e-3
+    with pytest.raises(AssertionError, match="checkgrad failures"):
+        pt.reset_default_programs()
+        pt.reset_global_scope()
+        x2 = layers.data("x", [4, 3], append_batch_size=False)
+        h2 = layers.fc(x2, size=2, bias_attr=False)
+        # loss uses |h|^3: big eps => finite differences diverge from
+        # the analytic tangent beyond tolerance
+        loss2 = layers.reduce_mean(layers.abs(h2) * layers.square(h2))
+        exe2 = pt.Executor()
+        exe2.run(pt.default_startup_program())
+        check_gradients(loss2, feed, eps=0.9,
+                        max_relative_error=1e-6,
+                        max_elements_per_param=3)
+
+
+def test_checkgrad_nonscalar_loss_and_repeat_calls():
+    """Per-sample (non-scalar) losses must check against d(sum)/dparam,
+    and a second call must not see the first call's grad ops (the
+    backward is appended to a CLONE)."""
+    x = layers.data("x", [4, 3], append_batch_size=False)
+    y = layers.data("y", [4, 1], append_batch_size=False)
+    cost = layers.square_error_cost(layers.fc(x, size=1), y)  # [4, 1]
+    r = np.random.RandomState(5)
+    feed = {"x": r.rand(4, 3).astype(np.float32),
+            "y": r.rand(4, 1).astype(np.float32)}
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rep1 = check_gradients(cost, feed, max_elements_per_param=4)
+    rep2 = check_gradients(cost, feed, max_elements_per_param=4)
+    assert max(rep1.values()) < 5e-3 and max(rep2.values()) < 5e-3
+    # the caller's program must stay free of grad ops
+    assert not any("@GRAD" in str(o.outputs)
+                   for o in cost.block.program.global_block().ops)
+
+
+def test_checkgrad_param_without_gradient_path():
+    """A trainable param not on the loss path checks cleanly against a
+    zero analytic gradient instead of raising KeyError."""
+    x = layers.data("x", [4, 3], append_batch_size=False)
+    used = layers.fc(x, size=1)
+    _unused = layers.create_parameter([2, 2], "float32",
+                                      name="aux_unused")
+    loss = layers.reduce_mean(layers.square(used))
+    feed = {"x": np.random.RandomState(6).rand(4, 3)
+            .astype(np.float32)}
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rep = check_gradients(loss, feed, max_elements_per_param=2)
+    assert "aux_unused" in rep and rep["aux_unused"] < 1e-6
+
+
+# -- the 8 book models ------------------------------------------------
+
+def _book_fit_a_line():
+    x = layers.data("x", [4, 13], append_batch_size=False)
+    y = layers.data("y", [4, 1], append_batch_size=False)
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    r = np.random.RandomState(1)
+    return loss, {"x": r.rand(4, 13).astype(np.float32),
+                  "y": r.rand(4, 1).astype(np.float32)}
+
+
+def _book_recognize_digits():
+    img = layers.data("img", [2, 1, 8, 8], append_batch_size=False)
+    y = layers.data("y", [2, 1], dtype="int64", append_batch_size=False)
+    conv = layers.conv2d(img, num_filters=2, filter_size=3, act="relu")
+    pool = layers.pool2d(conv, pool_size=2, pool_stride=2)
+    pred = layers.fc(layers.flatten(pool), size=10, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    r = np.random.RandomState(2)
+    return loss, {"img": r.rand(2, 1, 8, 8).astype(np.float32),
+                  "y": np.array([[1], [7]], np.int64)}
+
+
+def _book_image_classification():
+    img = layers.data("img", [2, 3, 8, 8], append_batch_size=False)
+    y = layers.data("y", [2, 1], dtype="int64", append_batch_size=False)
+    c1 = layers.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                       act="relu")
+    bn = layers.batch_norm(c1)
+    p1 = layers.pool2d(bn, pool_size=2, pool_stride=2)
+    pred = layers.fc(layers.flatten(p1), size=10, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    r = np.random.RandomState(3)
+    return loss, {"img": r.rand(2, 3, 8, 8).astype(np.float32),
+                  "y": np.array([[0], [9]], np.int64)}
+
+
+def _book_word2vec():
+    words = [layers.data(f"w{i}", [3, 1], dtype="int64",
+                         append_batch_size=False) for i in range(4)]
+    nxt = layers.data("nxt", [3, 1], dtype="int64",
+                      append_batch_size=False)
+    embs = [layers.embedding(w, size=[20, 6], param_attr="shared_emb")
+            for w in words]
+    concat = layers.concat(embs, axis=1)
+    hid = layers.fc(concat, size=8, act="sigmoid")
+    pred = layers.fc(hid, size=20, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, nxt))
+    r = np.random.RandomState(4)
+    feed = {f"w{i}": r.randint(0, 20, (3, 1)).astype(np.int64)
+            for i in range(4)}
+    feed["nxt"] = r.randint(0, 20, (3, 1)).astype(np.int64)
+    return loss, feed
+
+
+def _book_machine_translation():
+    src = layers.data("src", [1], dtype="int64", lod_level=1,
+                      append_batch_size=False)
+    trg = layers.data("trg", [1], dtype="int64", lod_level=1,
+                      append_batch_size=False)
+    lbl = layers.data("lbl", [1], dtype="int64", lod_level=1,
+                      append_batch_size=False)
+    semb = layers.embedding(src, size=[12, 8])
+    enc = layers.fc(semb, size=16, act="tanh")
+    hidden, _cell = layers.dynamic_lstm(enc, size=16)
+    ctx = layers.sequence_last_step(hidden)
+    temb = layers.embedding(trg, size=[12, 8])
+    dec_in = layers.fc(temb, size=8, act="tanh")
+    expanded = layers.sequence_expand(ctx, dec_in)
+    both = layers.concat([dec_in, expanded], axis=-1)
+    pred = layers.fc(both, size=12, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, lbl))
+    feed = {"src": _ragged([[1, 2, 3], [4, 5]], feat=[1]),
+            "trg": _ragged([[6, 7], [8, 9, 1]], feat=[1]),
+            "lbl": _ragged([[7, 2], [9, 1, 0]], feat=[1])}
+    return loss, feed
+
+
+def _book_label_semantic_roles():
+    word = layers.data("word", [1], dtype="int64", lod_level=1,
+                       append_batch_size=False)
+    lbl = layers.data("lbl", [1], dtype="int64", lod_level=1,
+                      append_batch_size=False)
+    emb = layers.embedding(word, size=[15, 6])
+    proj = layers.fc(emb, size=24, act="tanh")
+    hidden, _ = layers.dynamic_lstm(proj, size=24)
+    feat = layers.fc(hidden, size=5)
+    ll = layers.linear_chain_crf(feat, lbl)
+    loss = layers.mean(ll)
+    feed = {"word": _ragged([[1, 2, 3, 4], [5, 6]], feat=[1]),
+            "lbl": _ragged([[0, 1, 2, 0], [3, 4]], feat=[1])}
+    return loss, feed
+
+
+def _book_recommender_system():
+    uid = layers.data("uid", [3, 1], dtype="int64",
+                      append_batch_size=False)
+    mid = layers.data("mid", [3, 1], dtype="int64",
+                      append_batch_size=False)
+    score = layers.data("score", [3, 1], append_batch_size=False)
+    uvec = layers.fc(layers.embedding(uid, size=[10, 6]), size=8,
+                     act="tanh")
+    mvec = layers.fc(layers.embedding(mid, size=[12, 6]), size=8,
+                     act="tanh")
+    sim = layers.cos_sim(uvec, mvec)
+    loss = layers.mean(layers.square_error_cost(
+        layers.scale(sim, scale=5.0), score))
+    r = np.random.RandomState(6)
+    return loss, {"uid": r.randint(0, 10, (3, 1)).astype(np.int64),
+                  "mid": r.randint(0, 12, (3, 1)).astype(np.int64),
+                  "score": r.rand(3, 1).astype(np.float32) * 5}
+
+
+def _book_understand_sentiment():
+    words = layers.data("words", [1], dtype="int64", lod_level=1,
+                        append_batch_size=False)
+    y = layers.data("y", [2, 1], dtype="int64",
+                    append_batch_size=False)
+    emb = layers.embedding(words, size=[18, 6])
+    proj = layers.fc(emb, size=20, act="tanh")
+    hidden, _ = layers.dynamic_lstm(proj, size=20)
+    pooled = layers.sequence_pool(hidden, "max")
+    pred = layers.fc(pooled, size=2, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    feed = {"words": _ragged([[1, 2, 3], [4, 5, 6, 7]], feat=[1]),
+            "y": np.array([[0], [1]], np.int64)}
+    return loss, feed
+
+
+BOOKS = [_book_fit_a_line, _book_recognize_digits,
+         _book_image_classification, _book_word2vec,
+         _book_machine_translation, _book_label_semantic_roles,
+         _book_recommender_system, _book_understand_sentiment]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("builder", BOOKS, ids=lambda b: b.__name__)
+def test_checkgrad_book_models(builder):
+    loss, feed = builder()
+    report = _check(loss, feed, max_relative_error=8e-3, eps=2e-3)
+    worst = max(report.values())
+    assert worst <= 8e-3, (builder.__name__, report)

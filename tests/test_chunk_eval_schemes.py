@@ -1,0 +1,167 @@
+"""chunk_eval across all four labelling schemes, fuzz-checked against a
+host-side transcription of the reference evaluator's per-sequence walk
+(reference: paddle/gserver/evaluators/ChunkEvaluator.cpp:24-245 —
+getSegments + eval1; schemes plain/IOB/IOE/IOBES with tag layouts
+plain:1, IOB:B=0 I=1, IOE:I=0 E=1, IOBES:B=0 I=1 E=2 S=3)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.lod import LoDTensor, RaggedPair
+from op_test import OpTestHarness
+
+SCHEMES = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}
+
+
+def _scheme_tags(scheme):
+    """(tagBegin, tagInside, tagEnd, tagSingle) per the reference."""
+    return {"plain": (-1, -1, -1, -1), "IOB": (0, 1, -1, -1),
+            "IOE": (-1, 0, 1, -1), "IOBES": (0, 1, 2, 3)}[scheme]
+
+
+def _segments(labels, scheme, num_types):
+    """Reference getSegments transcribed (ChunkEvaluator.cpp:187-221)."""
+    num_tag = SCHEMES[scheme]
+    tb, ti, te, ts = _scheme_tags(scheme)
+    other = num_types
+
+    def is_end(p_tag, p_type, tag, type_):
+        if p_type == other:
+            return False
+        if type_ == other or type_ != p_type:
+            return True
+        if p_tag == tb or p_tag == ti:
+            return tag == tb or tag == ts
+        if p_tag == te or p_tag == ts:
+            return True
+        return False
+
+    def is_begin(p_tag, p_type, tag, type_):
+        if p_type == other:
+            return type_ != other
+        if type_ == other:
+            return False
+        if type_ != p_type:
+            return True
+        if tag == tb or tag == ts:
+            return True
+        if tag == ti or tag == te:
+            return p_tag == te or p_tag == ts
+        return False
+
+    segs = []
+    in_chunk, start = False, 0
+    tag, type_ = -1, other
+    for i, l in enumerate(labels):
+        p_tag, p_type = tag, type_
+        tag, type_ = l % num_tag, l // num_tag
+        if in_chunk and is_end(p_tag, p_type, tag, type_):
+            segs.append((start, i - 1, p_type))
+            in_chunk = False
+        if is_begin(p_tag, p_type, tag, type_):
+            start, in_chunk = i, True
+    if in_chunk:
+        segs.append((start, len(labels) - 1, type_))
+    return segs
+
+
+def _oracle(inf_seqs, lab_seqs, scheme, num_types, excluded=()):
+    """Reference eval1: matched-segment counting."""
+    n_inf = n_lab = n_cor = 0
+    for inf, lab in zip(inf_seqs, lab_seqs):
+        si = _segments(inf, scheme, num_types)
+        sl = _segments(lab, scheme, num_types)
+        i = j = 0
+        while i < len(si) and j < len(sl):
+            if si[i] == sl[j] and si[i][2] not in excluded:
+                n_cor += 1
+            if si[i][1] < sl[j][1]:
+                i += 1
+            elif si[i][1] > sl[j][1]:
+                j += 1
+            else:
+                i += 1
+                j += 1
+        n_lab += sum(1 for s in sl if s[2] not in excluded)
+        n_inf += sum(1 for s in si if s[2] not in excluded)
+    return n_inf, n_lab, n_cor
+
+
+def _run_op(inf_seqs, lab_seqs, scheme, num_types, excluded=()):
+    max_len = max(len(s) for s in inf_seqs)
+    inf = LoDTensor.from_sequences(
+        [np.asarray(s, np.int64).reshape(-1, 1) for s in inf_seqs])
+    lab = LoDTensor.from_sequences(
+        [np.asarray(s, np.int64).reshape(-1, 1) for s in lab_seqs])
+    pi, li = inf.to_padded(max_len=max_len)
+    pl, ll = lab.to_padded(max_len=max_len)
+    t = OpTestHarness(
+        "chunk_eval",
+        {"Inference": ("inf", RaggedPair(pi, li)),
+         "Label": ("lab", RaggedPair(pl, ll))},
+        attrs={"num_chunk_types": num_types, "chunk_scheme": scheme,
+               "excluded_chunk_types": list(excluded)},
+        out_slots=("Precision", "Recall", "F1-Score", "NumInferChunks",
+                   "NumLabelChunks", "NumCorrectChunks"),
+        out_dtypes={"NumInferChunks": "int64",
+                    "NumLabelChunks": "int64",
+                    "NumCorrectChunks": "int64"})
+    got = t.outputs()
+    return (int(got["NumInferChunks"]), int(got["NumLabelChunks"]),
+            int(got["NumCorrectChunks"]))
+
+
+def test_iob_hand_case():
+    # types: 0=PER 1=LOC, IOB labels: B-PER=0 I-PER=1 B-LOC=2 I-LOC=3 O=4
+    lab = [[0, 1, 4, 2, 3, 3], [2, 4, 0]]
+    inf = [[0, 1, 4, 2, 3, 4], [2, 4, 0]]  # second LOC chunk cut short
+    assert _run_op(inf, lab, "IOB", 2) == (4, 4, 3)
+
+
+def test_ioe_hand_case():
+    # IOE: I=0 E=1; types 0,1: I-0=0 E-0=1 I-1=2 E-1=3 O=4
+    lab = [[0, 0, 1, 4, 2, 3]]      # chunk0 [0..2], chunk1 [4..5]
+    inf = [[0, 1, 0, 1, 2, 3]]      # chunk0 [0..1], chunk0 [2..3], ch1
+    exp = _oracle(inf, lab, "IOE", 2)
+    assert _run_op(inf, lab, "IOE", 2) == exp
+    assert exp[2] == 1  # only the type-1 chunk matches
+
+
+def test_iobes_hand_case():
+    # IOBES type 0: B=0 I=1 E=2 S=3; type 1: B=4 I=5 E=6 S=7; O=8
+    lab = [[0, 1, 2, 8, 3, 7]]      # chunk [0..2], single [4], single [5]
+    inf = [[0, 1, 2, 8, 3, 8]]
+    assert _run_op(inf, lab, "IOBES", 2) == (2, 3, 2)
+
+
+def test_plain_hand_case():
+    # plain: label == type; 2 = Other
+    lab = [[0, 0, 1, 1, 2, 0]]      # chunks [0..1]x0, [2..3]x1, [5]x0
+    inf = [[0, 0, 1, 2, 2, 0]]
+    exp = _oracle(inf, lab, "plain", 2)
+    assert _run_op(inf, lab, "plain", 2) == exp
+
+
+@pytest.mark.parametrize("scheme", sorted(SCHEMES))
+def test_fuzz_against_reference_walk(scheme):
+    """Random tag sequences: the vectorized op must agree with the
+    transcribed reference walk on all three counts."""
+    rng = np.random.RandomState(hash(scheme) % 2 ** 31)
+    num_types = 3
+    hi = num_types * SCHEMES[scheme] + 1  # include the Other label
+    for trial in range(8):
+        lens = rng.randint(1, 9, size=3)
+        lab = [rng.randint(0, hi, n).tolist() for n in lens]
+        inf = [rng.randint(0, hi, n).tolist() for n in lens]
+        exp = _oracle(inf, lab, scheme, num_types)
+        got = _run_op(inf, lab, scheme, num_types)
+        assert got == exp, (scheme, trial, inf, lab, got, exp)
+
+
+def test_excluded_types_not_counted():
+    lab = [[0, 1, 4, 2, 3]]
+    inf = [[0, 1, 4, 2, 3]]
+    full = _run_op(inf, lab, "IOB", 2)
+    excl = _run_op(inf, lab, "IOB", 2, excluded=(1,))
+    assert full == (2, 2, 2) and excl == (1, 1, 1)
