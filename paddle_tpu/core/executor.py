@@ -432,7 +432,8 @@ class Executor:
                  feed_sig, fetch_names: Sequence[str],
                  scope: Scope,
                  while_bounds=None, iterations: int = 1,
-                 or_reduce_tail: int = 0) -> CompiledProgram:
+                 or_reduce_tail: int = 0,
+                 stacked_feed: bool = False) -> CompiledProgram:
         read_names, write_names = _collect_state_names(program, block, scope)
         fetch_names = list(fetch_names)
         # Donate only buffers that are overwritten (param updates); read-only
@@ -469,32 +470,43 @@ class Executor:
                 # K steps inside ONE compiled program (lax.scan over the
                 # traced step): per-dispatch overhead is paid once per K
                 # real steps, which is what makes ms-scale steps
-                # measurable through a high-RTT link. Each iteration
-                # consumes the same feed; rw state chains through the
-                # scan carry. Fetches and write-only state thread
-                # through the carry too (zero-init from eval_shape) —
-                # stacking K histories just to slice [-1] would cost
-                # K x device memory. The trailing `n_flags` fetches are
-                # bounded-While truncation flags: those OR across
-                # iterations, so a loop truncated at iteration 3 of 64
-                # still trips the check.
+                # measurable through a high-RTT link. With
+                # stacked_feed, feed arrays carry a leading K axis and
+                # the scan consumes one slice per iteration (K DISTINCT
+                # batches — unchanged SGD semantics); otherwise every
+                # iteration re-reads the same feed. rw state chains
+                # through the scan carry. Fetches and write-only state
+                # thread through the carry too (zero-init from
+                # eval_shape) — stacking K histories just to slice [-1]
+                # would cost K x device memory. The trailing `n_flags`
+                # fetches are bounded-While truncation flags: those OR
+                # across iterations, so a loop truncated at iteration 3
+                # of 64 still trips the check.
+                feed0 = {k: v[0] for k, v in feed_vals.items()} \
+                    if stacked_feed else feed_vals
                 zeros = jax.tree_util.tree_map(
                     lambda a: jnp.zeros(a.shape, a.dtype),
                     jax.eval_shape(
-                        lambda rw, st: step_fn(feed_vals, ro_state,
+                        lambda rw, st: step_fn(feed0, ro_state,
                                                rw, st),
                         rw_state, step))
                 f0, ns0 = zeros
                 e0 = {n: v for n, v in ns0.items() if n not in rw_names}
                 first_flag = len(fetch_names) - n_flags
 
-                def body(carry, _):
+                def body(carry, xs):
                     rw_c, st, f_c, _e_c = carry
-                    fetches, new_state = step_fn(feed_vals, ro_state,
+                    step_feed = xs if stacked_feed else feed_vals
+                    fetches, new_state = step_fn(step_feed, ro_state,
                                                  rw_c, st)
                     rw_next = {n: new_state.get(n, rw_c[n])
                                for n in rw_names}
-                    extra_w = {n: new_state.get(n, _e_c[n]) for n in e0}
+                    # e0 keys come from the eval_shape trace of this
+                    # very step_fn, so every one must be produced here
+                    # too — index directly so a divergence fails loudly
+                    # instead of silently writing the zero placeholder
+                    # back to the scope
+                    extra_w = {n: new_state[n] for n in e0}
                     f_out = [
                         jnp.logical_or(f_c[i], f) if i >= first_flag
                         else f
@@ -502,7 +514,8 @@ class Executor:
                     return (rw_next, st + 1, f_out, extra_w), None
 
                 (rw_f, _, fetches, extra_w), _ = jax.lax.scan(
-                    body, (rw_state, step, f0, e0), xs=None,
+                    body, (rw_state, step, f0, e0),
+                    xs=feed_vals if stacked_feed else None,
                     length=iterations)
                 new_state = dict(rw_f)
                 new_state.update(extra_w)
@@ -523,7 +536,7 @@ class Executor:
     def run(self, program: Program, feed: Optional[Dict[str, Any]] = None,
             fetch_list: Optional[Sequence] = None, scope: Optional[Scope] = None,
             return_numpy: bool = True, block_idx: int = 0,
-            iterations: int = 1):
+            iterations: int = 1, stacked_feed: bool = False):
         """Execute `program` block `block_idx` with `feed`, return fetches.
 
         feed values: numpy arrays, python scalars, or LoDTensor for ragged.
@@ -533,12 +546,17 @@ class Executor:
         program (a lax.scan over the traced step, state chained through
         the carry): the analog of the reference's repeated Executor.Run
         over a prepared context (executor.cc RunPreparedContext), but
-        paying per-call dispatch once per K steps. Every iteration
-        consumes the same feed; fetches are the FINAL iteration's values;
-        the step counter advances by `iterations`. Rejected for programs
-        with host-side stateful ops (channels/select/go — host callbacks
-        under scan are unverified) or unbounded-While gradients (the trip
-        count is probed against the INITIAL state only).
+        paying per-call dispatch once per K steps. With
+        stacked_feed=True each feed array carries a leading axis of
+        length `iterations` and every scan iteration consumes its own
+        slice — K DISTINCT batches per dispatch, unchanged SGD
+        semantics. Without it, every iteration re-reads the same feed
+        (useful for perf probes only). Fetches are the FINAL
+        iteration's values; the step counter advances by `iterations`.
+        Rejected for programs with host-side stateful ops
+        (channels/select/go — host callbacks under scan are unverified)
+        or unbounded-While gradients (the trip count is probed against
+        the INITIAL state only).
         """
         if hasattr(program, "desc"):  # accept the python builder wrapper
             program = program.desc
@@ -569,10 +587,30 @@ class Executor:
         if step is None:
             step = jnp.zeros((), jnp.int32)
 
+        # validate stacked feeds BEFORE the While probe: probing with
+        # (K, batch, ...) shapes the program was never built for would
+        # die in an opaque trace error instead of the messages below
+        if stacked_feed:
+            if iterations == 1:
+                raise ValueError("stacked_feed requires iterations > 1")
+            for k_, v_ in feed_vals.items():
+                if not hasattr(v_, "shape"):
+                    raise ValueError(
+                        f"stacked_feed: feed {k_!r} is not an array "
+                        "(ragged/LoDTensor feeds cannot be stacked — "
+                        "their padded length may differ per batch)")
+                if v_.shape[:1] != (iterations,):
+                    raise ValueError(
+                        f"stacked_feed: feed {k_!r} leading dim "
+                        f"{v_.shape[:1]} != iterations {iterations}")
+
         # unbounded-While gradients: measure trip counts with a forward
-        # probe, then compile with the bucketed bounds baked in
+        # probe, then compile with the bucketed bounds baked in; with
+        # stacked feeds the probe sees one PER-STEP slice
+        probe_feed = {k_: v_[0] for k_, v_ in feed_vals.items()} \
+            if stacked_feed else feed_vals
         while_bounds = self._probe_while_bounds(
-            program, block, feed_vals, feed_sig, scope, block_idx, step)
+            program, block, probe_feed, feed_sig, scope, block_idx, step)
 
         if iterations < 1:
             raise ValueError(
@@ -601,12 +639,13 @@ class Executor:
         key = (program.uid, program.version, feed_sig, tuple(fetch_names),
                block_idx, amp_enabled(),
                tuple(sorted(while_bounds.items())) if while_bounds
-               else None, iterations)
+               else None, iterations, stacked_feed)
         compiled = self._cache.get(key)
         if compiled is None:
             kw = {} if iterations == 1 else {
                 "iterations": iterations,
-                "or_reduce_tail": len(exhausted)}
+                "or_reduce_tail": len(exhausted),
+                "stacked_feed": stacked_feed}
             compiled = self._compile(program, block, feed_sig, fetch_names,
                                      scope, while_bounds=while_bounds,
                                      **kw)

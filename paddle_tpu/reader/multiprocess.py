@@ -139,8 +139,14 @@ def multiprocess_batch_reader(worker_fn: Callable, num_workers: int,
     produced by `num_workers` processes each running
     `worker_fn(worker_idx, num_workers, **worker_kwargs)`.
 
-    Yielded arrays are views into shared memory, valid until the next
-    `next()`. Closing the generator shuts the workers down."""
+    ALIASING HAZARD: yielded arrays are READ-ONLY views into a
+    shared-memory slot the producer overwrites once the consumer
+    advances — they are valid only until the next `next()`. Callers
+    that accumulate batches (e.g. for a later concat) must copy:
+    `tuple(a.copy() for a in batch)`. The views are marked
+    non-writeable so accidental in-place mutation raises instead of
+    racing the producer. Closing the generator shuts the workers
+    down."""
     if num_workers < 1:
         raise ValueError("num_workers must be >= 1")
 
@@ -204,6 +210,9 @@ def multiprocess_batch_reader(worker_fn: Callable, num_workers: int,
                                 shm.buf, dtype=np.dtype(dtype),
                                 count=int(np.prod(shape, dtype=np.int64)),
                                 offset=off).reshape(shape)
+                            # consumers must not mutate the producer's
+                            # slot in place (see factory docstring)
+                            a.flags.writeable = False
                             vs.append(a)
                             off += a.nbytes
                         views.append(tuple(vs))

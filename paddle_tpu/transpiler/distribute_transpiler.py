@@ -91,8 +91,13 @@ class DistributeTranspiler:
                 self.decisions[p.name] = "tp-col-shard"
             else:
                 if model_par > 1 and p.name not in pairs and \
+                        len(shape) == 2 and \
                         p.name.split(".")[0].startswith(
                             ("tp_col_", "tp_row_")):
+                    # 1-D biases inherit the layer's name prefix but can
+                    # never be 2-D sharded — warning on them is noise
+                    # (uses the normalized local `shape`: p.shape can
+                    # be None)
                     import warnings
                     warnings.warn(
                         f"param {p.name!r} carries a Megatron TP hint "

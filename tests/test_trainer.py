@@ -176,23 +176,29 @@ def test_trainer_steps_per_dispatch():
         return main, startup, loss
 
     rng = np.random.RandomState(0)
-    xv = rng.rand(16, 4).astype(np.float32)
-    yv = xv.sum(1, keepdims=True)
+    # 12 DISTINCT batches — K>1 must consume them one per scan
+    # iteration, exactly like K=1 consumes them sequentially
+    batches = []
+    for i in range(12):
+        xv = rng.rand(16, 4).astype(np.float32)
+        batches.append({"x": xv, "label": xv.sum(1, keepdims=True)})
 
     def reader():
-        for _ in range(3):
-            yield {"x": xv, "label": yv}
+        yield from batches
 
-    # baseline: the same 12 steps as 12 single-step dispatches
+    # baseline: 12 single-step dispatches over the same batch stream
     pt.reset_global_scope()
     main, startup, loss = build()
     t0 = Trainer(loss, main_program=main, startup_program=startup)
     base_costs = []
-    t0.train(1, lambda: iter([{"x": xv, "label": yv}] * 12),
+    t0.train(1, reader,
              event_handler=lambda e: base_costs.append(e.cost)
              if isinstance(e, EndIteration) else None)
+    from paddle_tpu.core.scope import global_scope
+    w_name = main.all_parameters()[0].name
+    base_w = np.array(np.asarray(global_scope().get(w_name)))
 
-    # 3 dispatches of K=4 = 12 steps
+    # 3 dispatches of K=4 consume the same 12 distinct batches
     pt.reset_global_scope()
     main, startup, loss = build()
     with tempfile.TemporaryDirectory() as d:
@@ -204,15 +210,47 @@ def test_trainer_steps_per_dispatch():
                  if isinstance(e, EndIteration) else None,
                  steps_per_dispatch=4)
         assert len(events) == 3           # one event per dispatch
-        assert tr.step == 12              # K per dispatch
+        assert tr.step == 12              # every batch consumed once
         import os
         assert os.listdir(d), "stride-crossed checkpoint not written"
-    # K-scanned training must MATCH single-step training: the event
-    # after dispatch i carries the cost of step (i+1)*K, i.e. the loss
-    # computed FROM the state after (i+1)*K - 1 updates — compare each
-    # against the corresponding single-step cost
+    # CONVERGENCE PARITY: the event after dispatch i carries the cost
+    # of batch (i+1)*K-1 computed from the state after the same number
+    # of updates as the K=1 run — and the final weights must match
     for i, ev in enumerate(events):
         np.testing.assert_allclose(ev.cost, base_costs[(i + 1) * 4 - 1],
                                    rtol=1e-4, atol=1e-6)
+    k_w = np.array(np.asarray(
+        global_scope().get(main.all_parameters()[0].name)))
+    np.testing.assert_allclose(k_w, base_w, rtol=1e-5, atol=1e-6)
     with pytest.raises(ValueError, match="steps_per_dispatch"):
         tr.train(1, reader, steps_per_dispatch=0)
+
+
+def test_trainer_steps_per_dispatch_tail():
+    """A pass whose batch count is not a multiple of K runs the tail
+    batches one at a time — nothing dropped, nothing repeated."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.trainer import EndIteration, Trainer
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        label = layers.data("label", [1], dtype="float32")
+        loss = layers.mean(layers.square_error_cost(
+            layers.fc(x, size=1), label))
+        pt.optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
+
+    rng = np.random.RandomState(1)
+    batches = []
+    for _ in range(7):                    # 7 = 4 + tail of 3
+        xv = rng.rand(8, 4).astype(np.float32)
+        batches.append({"x": xv, "label": xv.sum(1, keepdims=True)})
+    tr = Trainer(loss, main_program=main, startup_program=startup)
+    events = []
+    tr.train(1, lambda: iter(batches),
+             event_handler=lambda e: events.append(e)
+             if isinstance(e, EndIteration) else None,
+             steps_per_dispatch=4)
+    assert tr.step == 7
+    assert len(events) == 2               # full dispatch + tail
