@@ -1,0 +1,66 @@
+"""Helpers for the composed fault-tolerance test
+(test_fault_tolerance.py). Importable by reference so the spawn-based
+multiprocess reader can pickle the worker fn, and runnable as a script
+for the straggler process the test SIGKILLs.
+
+Usage as script:  python ft_helpers.py <master_endpoint> <status_file>
+  connects to the master, pulls ONE task, records (task_id, epoch) to
+  status_file, then hangs — simulating a worker that dies mid-task.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+N_TASKS = 12
+BATCH = 8
+DIM = 6
+W_TRUE = None
+
+
+def _w_true():
+    global W_TRUE
+    if W_TRUE is None:
+        W_TRUE = np.random.RandomState(777).randn(DIM, 1) \
+            .astype(np.float32)
+    return W_TRUE
+
+
+def batch_for(seed: int):
+    """Deterministic batch for a master task payload."""
+    r = np.random.RandomState(1000 + seed)
+    x = r.randn(BATCH, DIM).astype(np.float32)
+    y = (x @ _w_true() + 0.1).astype(np.float32)
+    return x, y
+
+
+def reader_worker(widx: int, num_workers: int):
+    """multiprocess_batch_reader worker: streams every task's batch
+    (tagged with its seed) in task order."""
+    for seed in range(widx, N_TASKS, num_workers):
+        x, y = batch_for(seed)
+        yield (np.full((1,), seed, np.int64), x, y)
+
+
+def main():
+    endpoint, status_file = sys.argv[1], sys.argv[2]
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.distributed.master import MasterClient
+
+    client = MasterClient(endpoint)
+    payload, task_id, epoch = client.get_task()
+    assert payload is not None, "straggler got no task"
+    with open(status_file + ".tmp", "w") as f:
+        json.dump({"task_id": task_id, "epoch": epoch,
+                   "payload": json.loads(payload.decode())}, f)
+    os.replace(status_file + ".tmp", status_file)
+    import time
+    time.sleep(600)     # hang until SIGKILLed
+
+
+if __name__ == "__main__":
+    main()

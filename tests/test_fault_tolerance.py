@@ -1,0 +1,211 @@
+"""COMPOSED fault-tolerance: the scenario the reference's Go master
+exists for (reference: go/master/service.go:313-355 timeout requeue +
+epoch-stale-ack rejection; go/pserver elastic state), driven end to end
+in one test instead of per-piece:
+
+  master serves shard tasks -> a data-parallel trainer (multiprocess
+  SHM reader feeding a 2-device mesh) trains and elastically
+  checkpoints -> a straggler worker process pulls a task and is
+  SIGKILLed mid-task -> the master requeues it on timeout and rejects
+  the stale ack -> training RESUMES on a DIFFERENT mesh shape (4
+  devices) from the sharded checkpoint and the loss trajectory
+  CONTINUES (vs. a fresh-init control) until every task is done.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.distributed.master import (Master, MasterClient,
+                                           MasterServer)
+from paddle_tpu.parallel import make_mesh
+from paddle_tpu.parallel.executor import ParallelExecutor, ShardingSpec
+
+import ft_helpers
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _build_model():
+    """Identical auto names on every build (phase B must restore the
+    phase-A checkpoint by name)."""
+    from paddle_tpu.framework import isolated_name_scope
+    main, startup = pt.Program(), pt.Program()
+    with isolated_name_scope(), pt.program_guard(main, startup):
+        x = layers.data("x", [ft_helpers.DIM], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+        pt.optimizer.MomentumOptimizer(
+            learning_rate=0.05, momentum=0.9).minimize(loss)
+    return main, startup, loss
+
+
+def _run_task(pexe, main, loss, seed, batch_cache):
+    x, y = batch_cache[seed]
+    (lv,) = pexe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+    return float(np.asarray(getattr(lv, "data", lv)).reshape(-1)[0])
+
+
+def _drain_reader(reader_gen):
+    """Pull every (seed, x, y) batch from the multiprocess reader into
+    a host-side cache (copies — the views alias producer slots)."""
+    cache = {}
+    for tagged in reader_gen:
+        seed = int(np.asarray(tagged[0])[0])
+        cache[seed] = (np.array(tagged[1]), np.array(tagged[2]))
+        if len(cache) == ft_helpers.N_TASKS:
+            break
+    return cache
+
+
+@pytest.mark.slow
+def test_kill_requeue_and_cross_topology_resume(tmp_path):
+    # -- master with a short task timeout ---------------------------
+    master = Master(timeout_s=1.0, failure_max=3)
+    # huge tick interval: the TEST drives requeue ticks deterministically
+    server = MasterServer(master, host="127.0.0.1", port=0,
+                          tick_interval_s=3600).start()
+    try:
+        _drive(master, server.endpoint, tmp_path)
+    finally:
+        server.shutdown()
+
+
+def _drive(master, endpoint, tmp_path):
+    tasks = [json.dumps({"seed": i}).encode()
+             for i in range(ft_helpers.N_TASKS)]
+    client = MasterClient(endpoint)
+    client.set_dataset(tasks)
+
+    # -- the input pipeline: multiprocess SHM reader ----------------
+    from paddle_tpu.reader.multiprocess import multiprocess_batch_reader
+    reader = multiprocess_batch_reader(ft_helpers.reader_worker,
+                                       num_workers=1)
+    gen = reader()
+    try:
+        batch_cache = _drain_reader(gen)
+    finally:
+        gen.close()
+    assert len(batch_cache) == ft_helpers.N_TASKS
+
+    # -- phase A: dp2 trainer processes 5 tasks, checkpoints --------
+    main, startup, loss = _build_model()
+    mesh2 = make_mesh((2,), ("data",), devices=jax.devices()[:2])
+    pexe2 = ParallelExecutor(mesh=mesh2,
+                             sharding=ShardingSpec(feed_axis="data"))
+    pt.Executor().run(startup)
+
+    # fixed probe batch: all trajectory comparisons use THIS loss
+    probe_seed = 0
+    eval0 = _run_eval(pexe2, main, loss, probe_seed, batch_cache)
+    losses_a, acked = [], []
+    for _ in range(5):
+        payload, task_id, epoch = client.get_task()
+        assert payload is not None
+        seed = json.loads(payload.decode())["seed"]
+        losses_a.append(_run_task(pexe2, main, loss, seed, batch_cache))
+        assert client.task_finished(task_id, epoch)
+        acked.append(seed)
+    eval_after_a = _run_eval(pexe2, main, loss, probe_seed, batch_cache)
+    assert eval_after_a < eval0, (eval0, eval_after_a)
+
+    ckpt = str(tmp_path / "elastic_ckpt")
+    from paddle_tpu.distributed.sharded_checkpoint import (load_sharded,
+                                                           save_sharded)
+    save_sharded(ckpt)      # params + momentum accumulators + step var
+
+    # -- the straggler: pulls a task, gets SIGKILLed mid-task -------
+    status_file = str(tmp_path / "straggler_status.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(HERE, "ft_helpers.py"),
+         endpoint, status_file], env=env)
+    deadline = time.time() + 60
+    while not os.path.exists(status_file):
+        assert proc.poll() is None, "straggler died before pulling"
+        assert time.time() < deadline, "straggler never pulled a task"
+        time.sleep(0.05)
+    with open(status_file) as f:
+        st = json.load(f)
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait(timeout=30)
+
+    # -- master requeues on timeout; stale ack is REJECTED ----------
+    before = master.counts()
+    assert before["pending"] == 1          # the straggler's task
+    time.sleep(1.2)                        # > timeout_s
+    requeued = master.tick()
+    assert requeued == 1, "dead worker's task was not requeued"
+    after = master.counts()
+    assert after["pending"] == 0 and after["todo"] == before["todo"] + 1
+    # the requeue bumped the task's epoch: the dead worker's ack (or a
+    # zombie's late ack) must bounce
+    assert client.task_finished(st["task_id"], st["epoch"]) is False
+
+    # -- phase B: fresh scope, DIFFERENT mesh (dp4), elastic restore
+    pt.reset_global_scope()
+    main_b, startup_b, loss_b = _build_model()
+    mesh4 = make_mesh((4,), ("data",), devices=jax.devices()[:4])
+    pexe4 = ParallelExecutor(mesh=mesh4,
+                             sharding=ShardingSpec(feed_axis="data"))
+    pt.Executor().run(startup_b)
+
+    # control: FRESH params on the probe batch
+    fresh_loss = _run_eval(pexe4, main_b, loss_b, probe_seed,
+                           batch_cache)
+
+    load_sharded(ckpt)      # cross-topology: dp2 checkpoint, dp4 mesh
+
+    # LOSS CONTINUITY, part 1: the restored model scores the probe
+    # batch exactly as it did before the kill — the trajectory
+    # CONTINUES rather than restarting (fresh init is far worse)
+    eval_resumed = _run_eval(pexe4, main_b, loss_b, probe_seed,
+                             batch_cache)
+    np.testing.assert_allclose(eval_resumed, eval_after_a, rtol=1e-4)
+    assert fresh_loss > eval_resumed * 2, (fresh_loss, eval_resumed)
+
+    # resume consumes every remaining task, incl. the requeued one
+    losses_b, seen = [], []
+    while True:
+        payload, task_id, epoch = client.get_task()
+        if payload is None:
+            break
+        seed = json.loads(payload.decode())["seed"]
+        seen.append(seed)
+        losses_b.append(_run_task(pexe4, main_b, loss_b, seed,
+                                  batch_cache))
+        assert client.task_finished(task_id, epoch)
+    assert st["payload"]["seed"] in seen, \
+        "requeued task never re-served"
+    assert sorted(acked + seen) == list(range(ft_helpers.N_TASKS))
+    assert master.counts()["done"] == ft_helpers.N_TASKS
+
+    # LOSS CONTINUITY, part 2: training kept improving after resume
+    eval_final = _run_eval(pexe4, main_b, loss_b, probe_seed,
+                           batch_cache)
+    assert eval_final < eval_after_a, (eval_final, eval_after_a)
+    assert np.isfinite(losses_b).all()
+
+
+def _run_eval(pexe, main, loss, seed, batch_cache):
+    """Loss WITHOUT updating params: evaluate on an inference-pruned
+    clone so optimizer ops don't run."""
+    from paddle_tpu.io import _prune
+    pruned = _prune(main, [], [loss.name])
+    x, y = batch_cache[seed]
+    (lv,) = pexe.run(pruned, feed={"x": x, "y": y},
+                     fetch_list=[loss.name])
+    return float(np.asarray(getattr(lv, "data", lv)).reshape(-1)[0])
