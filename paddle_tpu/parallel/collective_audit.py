@@ -229,10 +229,12 @@ def assert_collectives(inv, expectations, forbid=()) -> None:
                 "inventory:\n" + format_inventory(inv))
 
 
-def compiled_hlo_for(exe, program, scope=None) -> str:
-    """Compiled HLO text of the (single) cached executable for
-    `program` in executor `exe` — AOT re-lowering with the same
-    abstract state the last run used."""
+def aot_compiled_for(exe, program, scope=None):
+    """AOT re-lower + compile the cached executable for `program` in
+    executor `exe`, with the same abstract state the last run used.
+    The one shared implementation of the cache-lookup-by-uid +
+    ro/rw-from-scope + jitted.lower(...).compile() dance (used by the
+    collective audit AND bench.py cost analysis)."""
     import jax.numpy as jnp
     import paddle_tpu as pt
     scope = pt.global_scope() if scope is None else scope
@@ -248,8 +250,14 @@ def compiled_hlo_for(exe, program, scope=None) -> str:
     if feed_vals is None:
         raise RuntimeError(
             "no recorded feed for AOT lowering — run the program once "
-            "before compiled_hlo_for (the executor records the last "
+            "before aot_compiled_for (the executor records the last "
             "feed values)")
     lowered = entry.jitted.lower(feed_vals, ro, rw,
                                  jnp.zeros((), jnp.int32))
-    return lowered.compile().as_text()
+    return lowered.compile()
+
+
+def compiled_hlo_for(exe, program, scope=None) -> str:
+    """Compiled HLO text of the (single) cached executable for
+    `program` in executor `exe`."""
+    return aot_compiled_for(exe, program, scope=scope).as_text()

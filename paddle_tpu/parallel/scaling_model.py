@@ -92,14 +92,17 @@ def _collective_time(kind: str, total_bytes: int, count: int, n: int,
     return total_bytes / bw
 
 
-def predict(inv, mesh_axis_sizes: Dict[str, int], t_comp: float) -> Dict:
+def predict(inv, mesh_axis_sizes: Dict[str, int], t_comp: float,
+            bw: float = ICI_BW, lat: float = ICI_LAT) -> Dict:
     """Combine an audit inventory with the interconnect model.
 
     inv: {(kind, axes): (count, bytes)} from collective_audit.inventory
     mesh_axis_sizes: {axis_name: size}
     t_comp: measured-anchor single-chip compute seconds per step
+    bw/lat: ICI constants — overridable for sensitivity sweeps
     """
-    out = predict_multihost(inv, mesh_axis_sizes, t_comp, hosts=1)
+    out = predict_multihost(inv, mesh_axis_sizes, t_comp, hosts=1,
+                            bw=bw, lat=lat)
     for k in ("hosts", "chips_per_host", "t_dcn_ms"):
         out.pop(k)
     return out
@@ -116,7 +119,8 @@ def predict(inv, mesh_axis_sizes: Dict[str, int], t_comp: float) -> Dict:
 
 def predict_multihost(inv, mesh_axis_sizes: Dict[str, int],
                       t_comp: float, hosts: int,
-                      dcn_axis: str = "data") -> Dict:
+                      dcn_axis: str = "data",
+                      bw: float = ICI_BW, lat: float = ICI_LAT) -> Dict:
     """Two-tier (ICI intra-host + DCN inter-host) prediction — the
     multi-host continuation of `predict`, answering the question the
     reference answered with its multi-host pserver tables
@@ -145,13 +149,13 @@ def predict_multihost(inv, mesh_axis_sizes: Dict[str, int],
             assert mesh_axis_sizes[dcn_axis] % hosts == 0, (
                 dcn_axis, mesh_axis_sizes[dcn_axis], hosts)
             g = n // hosts
-            t_ici = _collective_time(kind, b, count, g)
+            t_ici = _collective_time(kind, b, count, g, bw=bw, lat=lat)
             t_dcn = _collective_time(kind, b // g, count, hosts,
                                      bw=DCN_BW, lat=DCN_LAT)
             t = t_ici + t_dcn
             t_dcn_total += t_dcn
         else:
-            t = _collective_time(kind, b, count, n)
+            t = _collective_time(kind, b, count, n, bw=bw, lat=lat)
         t_comm += t
         for a in axes:
             per_axis[a] = per_axis.get(a, 0.0) + t
@@ -383,6 +387,18 @@ def scaling_report(n_list=(8, 16, 64), configs=("resnet50",
             assert not unattributed, (cfg, n, unattributed)
             pred = predict(inv, axis_sizes, _t_comp(cfg, axis_sizes))
             pred["mesh"] = axis_sizes
+            # +-2x ICI-bandwidth sensitivity band: the one constant a
+            # single-chip environment cannot measure. If the efficiency
+            # conclusion survives bw/2, it does not hinge on the 45 GB/s
+            # assumption.
+            pred["sensitivity"] = {}
+            for label, scale in (("bw_x0.5", 0.5), ("bw_x2.0", 2.0)):
+                sp = predict(inv, axis_sizes, _t_comp(cfg, axis_sizes),
+                             bw=ICI_BW * scale)
+                pred["sensitivity"][label] = {
+                    "eff_serial": sp["eff_serial"],
+                    "eff_overlap": sp["eff_overlap"],
+                    "t_comm_ms": sp["t_comm_ms"]}
             pred["inventory"] = {
                 f"{kind} over {'+'.join(axes)}": [cnt, b]
                 for (kind, axes), (cnt, b) in sorted(

@@ -85,3 +85,17 @@ def test_predict_multihost_decomposition():
     # model-axis (intra-host) share is identical in both
     assert mh["t_comm_ms"] >= flat["t_comm_ms"]
     assert mh["per_axis_ms"]["model"] == flat["per_axis_ms"]["model"]
+
+
+def test_sensitivity_band_orders_with_bandwidth():
+    """+-2x ICI bandwidth must move efficiency monotonically: half the
+    bandwidth can only hurt, double can only help — and the report
+    carries the band (round-5 VERDICT item 9)."""
+    from paddle_tpu.parallel.scaling_model import ICI_BW, predict
+    inv = {("all-reduce", ("data",)): (4, 40_000_000)}
+    sizes = {"data": 8}
+    base = predict(inv, sizes, t_comp=5e-3)
+    lo = predict(inv, sizes, t_comp=5e-3, bw=ICI_BW * 0.5)
+    hi = predict(inv, sizes, t_comp=5e-3, bw=ICI_BW * 2.0)
+    assert lo["eff_serial"] < base["eff_serial"] < hi["eff_serial"]
+    assert lo["t_comm_ms"] > base["t_comm_ms"] > hi["t_comm_ms"]
