@@ -638,3 +638,165 @@ def test_registry_coverage_gate():
         f"{len(unaccounted)} registered op(s) have no test and no waiver: "
         f"{unaccounted} — add an oracle check (see this file) or a "
         f"waiver with a reason")
+
+
+# -- round-5 second sweep: simple ops that had no DIRECT oracle ------------
+
+def test_elementwise_unary_battery():
+    x = _r((3, 4), 60, -2, 2)
+    for op, fn in [("abs", np.abs), ("ceil", np.ceil),
+                   ("floor", np.floor), ("cos", np.cos),
+                   ("sin", np.sin)]:
+        OpTestHarness(op, {"X": ("x", x)}).check_output(
+            {"Out": fn(x)}, atol=1e-6, rtol=1e-5)
+
+
+def test_cast_and_isfinite():
+    x = _r((2, 3), 61, -5, 5)
+    t = OpTestHarness("cast", {"X": ("x", x)},
+                      attrs={"out_dtype": "int32"},
+                      out_dtypes={"Out": "int32"})
+    np.testing.assert_array_equal(t.outputs()["Out"], x.astype(np.int32))
+    y = x.copy()
+    y[0, 0] = np.inf
+    t2 = OpTestHarness("isfinite", {"X": ("y", y)},
+                       out_dtypes={"Out": "bool"})
+    got = np.asarray(t2.outputs()["Out"]).reshape(-1)
+    # reference isfinite_op reduces to ONE flag for the whole tensor
+    exp = np.isfinite(y)
+    assert got.shape == (1,) and got[0] == exp.all() or \
+        np.array_equal(got, exp.reshape(-1))
+
+
+def test_clip_scale_pow_increment():
+    x = _r((3, 4), 62, -2, 2)
+    OpTestHarness("clip", {"X": ("x", x)},
+                  attrs={"min": -0.5, "max": 0.5}) \
+        .check_output({"Out": np.clip(x, -0.5, 0.5)})
+    OpTestHarness("scale", {"X": ("x", x)},
+                  attrs={"scale": 2.0, "bias": 1.0}) \
+        .check_output({"Out": 2.0 * x + 1.0}, atol=1e-6)
+    xp = _r((4,), 63, 0.5, 2.0)
+    t = OpTestHarness("pow", {"X": ("xp", xp)}, attrs={"factor": 3.0})
+    t.check_output({"Out": xp ** 3.0}, atol=1e-5, rtol=1e-5)
+    t.check_grad(["xp"])
+    one = np.array([5.0], np.float32)
+    OpTestHarness("increment", {"X": ("i", one)},
+                  attrs={"step": 2.0}) \
+        .check_output({"Out": np.array([7.0], np.float32)})
+
+
+def test_fills_and_ranges():
+    OpTestHarness("fill_constant", {}, attrs={
+        "shape": [2, 3], "dtype": "float32", "value": 4.5}) \
+        .check_output({"Out": np.full((2, 3), 4.5, np.float32)})
+    OpTestHarness("eye", {}, attrs={"num_rows": 3, "num_columns": 4,
+                                    "dtype": "float32"}) \
+        .check_output({"Out": np.eye(3, 4, dtype=np.float32)})
+    t = OpTestHarness("range", {}, attrs={"start": 2.0, "end": 10.0,
+                                          "step": 2.0,
+                                          "dtype": "float32"})
+    np.testing.assert_allclose(t.outputs()["Out"],
+                               np.arange(2.0, 10.0, 2.0))
+    t2 = OpTestHarness("linspace", {}, attrs={"start": 0.0,
+                                              "stop": 1.0, "num": 5})
+    np.testing.assert_allclose(t2.outputs()["Out"],
+                               np.linspace(0, 1, 5), atol=1e-6)
+    t3 = OpTestHarness("randint", {}, attrs={"shape": [500], "low": 3,
+                                             "high": 9,
+                                             "dtype": "int64"},
+                       out_dtypes={"Out": "int64"})
+    out = t3.outputs()["Out"]
+    assert out.min() >= 3 and out.max() < 9 and out.shape == (500,)
+
+
+def test_matmul_mean_sum_assign_shape():
+    a, b = _r((3, 4), 64), _r((4, 5), 65)
+    t = OpTestHarness("matmul", {"X": ("a", a), "Y": ("b", b)})
+    t.check_output({"Out": a @ b}, atol=1e-5, rtol=1e-4)
+    t.check_grad(["a", "b"])
+    OpTestHarness("mean", {"X": ("a", a)}) \
+        .check_output({"Out": a.mean()}, rtol=1e-6)
+    OpTestHarness("sum", {"X": [("a", a), ("a2", a + 1)]}) \
+        .check_output({"Out": 2 * a + 1}, atol=1e-6)
+    OpTestHarness("assign", {"X": ("a", a)}).check_output({"Out": a})
+    t4 = OpTestHarness("shape", {"X": ("a", a)},
+                       out_dtypes={"Out": "int64"})
+    np.testing.assert_array_equal(t4.outputs()["Out"], [3, 4])
+
+
+def test_accuracy_and_cross_entropy():
+    probs = np.array([[0.1, 0.7, 0.2], [0.6, 0.3, 0.1]], np.float32)
+    label = np.array([[1], [2]], np.int64)
+    # accuracy consumes top-k INDICES (reference accuracy_op.cc)
+    topk_idx = np.argsort(-probs, axis=1)[:, :1].astype(np.int64)
+    t = OpTestHarness("accuracy", {"Out": ("p", probs),
+                                   "Indices": ("i", topk_idx),
+                                   "Label": ("l", label)},
+                      out_slots=("Accuracy",))
+    np.testing.assert_allclose(t.outputs()["Accuracy"], 0.5, atol=1e-6)
+    t2 = OpTestHarness("cross_entropy", {"X": ("p", probs),
+                                         "Label": ("l", label)},
+                       out_slots=("Y",))
+    exp = -np.log(probs[np.arange(2), label.reshape(-1)] + 1e-8) \
+        .reshape(-1, 1)
+    t2.check_output({"Y": exp}, atol=1e-5, rtol=1e-5)
+
+
+def test_interp_ops():
+    x = _r((1, 1, 2, 2), 66)
+    t = OpTestHarness("nearest_interp", {"X": ("x", x)},
+                      attrs={"out_h": 4, "out_w": 4})
+    got = t.outputs()["Out"]
+    assert got.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(got[0, 0, ::3, ::3],
+                               x[0, 0][[0, 1]][:, [0, 1]], atol=1e-6)
+    t2 = OpTestHarness("bilinear_interp", {"X": ("x", x)},
+                       attrs={"out_h": 3, "out_w": 3})
+    g2 = t2.outputs()["Out"]
+    assert g2.shape == (1, 1, 3, 3)
+    assert g2.min() >= x.min() - 1e-5 and g2.max() <= x.max() + 1e-5
+
+
+def test_dropout_modes():
+    x = np.ones((64, 64), np.float32)
+    t = OpTestHarness("dropout", {"X": ("x", x)},
+                      attrs={"dropout_prob": 0.5, "is_test": True})
+    # test mode (downgrade_in_infer): identity-scaled output
+    got = t.outputs()["Out"]
+    assert np.allclose(got, x) or np.allclose(got, 0.5 * x)
+    t2 = OpTestHarness("dropout", {"X": ("x", x)},
+                       attrs={"dropout_prob": 0.5, "is_test": False})
+    g2 = t2.outputs()["Out"]
+    kept = (g2 != 0).mean()
+    assert 0.3 < kept < 0.7, kept  # ~half dropped
+
+
+def test_sequence_last_step_and_conv():
+    rp, seqs = _ragged([_r((n, 3), 67 + n) for n in (4, 2, 5)], 6)
+    t = OpTestHarness("sequence_last_step", {"X": ("x", rp)})
+    t.check_output({"Out": np.stack([s[-1] for s in seqs])}, atol=1e-6)
+
+    # sequence_conv: context-window projection per sequence (reference
+    # sequence_conv_op.cc). Oracle: pad each sequence with zeros at the
+    # context boundary, gather the window, multiply the filter.
+    d, ctx_len, out_d = 3, 3, 4
+    ctx_start = -(ctx_len // 2)
+    w = _r((ctx_len * d, out_d), 70)
+    t2 = OpTestHarness("sequence_conv",
+                       {"X": ("x", rp), "Filter": ("w", w)},
+                       attrs={"contextLength": ctx_len,
+                              "contextStart": ctx_start})
+    exp = []
+    for s_ in seqs:
+        n_ = len(s_)
+        for pos in range(n_):
+            window = []
+            for k in range(ctx_len):
+                j = pos + ctx_start + k
+                window.append(s_[j] if 0 <= j < n_
+                              else np.zeros(d, np.float32))
+            exp.append(np.concatenate(window) @ w)
+    np.testing.assert_allclose(t2.outputs()["Out"], np.stack(exp),
+                               atol=1e-5, rtol=1e-4)
+    t2.check_grad(["w"], max_relative_error=1e-2)
