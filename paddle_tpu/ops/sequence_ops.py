@@ -753,3 +753,37 @@ def _sequence_reverse(ctx):
         x.data, src.reshape(src.shape + (1,) * (x.data.ndim - 2)),
         axis=1)
     ctx.set_output("Y", RaggedPair(out, x.lengths))
+
+
+@register_op_SEQ("multihead_seq_attention")
+def _multihead_seq_attention(ctx):
+    """Multi-head self/cross attention over RAGGED sequences (the v2
+    networks.multi_head_attention composition, reference:
+    trainer_config_helpers/networks.py:1580 — realized as one fused
+    ragged op so padding is masked exactly; the modern dense-tensor
+    path is ops 'scaled_dot_product_attention')."""
+    q = _as_ragged(ctx.input("Q"))
+    k = _as_ragged(ctx.input("K"))
+    v = _as_ragged(ctx.input("V"))
+    wq, wk = ctx.input("WQ"), ctx.input("WK")
+    wv, wo = ctx.input("WV"), ctx.input("WO")
+    heads = ctx.attr("num_heads", 1)
+    qp = jnp.einsum("btd,de->bte", q.data, wq)
+    kp = jnp.einsum("btd,de->bte", k.data, wk)
+    vp = jnp.einsum("btd,de->bte", v.data, wv)
+    b, t, d = qp.shape
+    dh = d // heads
+
+    def split(x):
+        return x.reshape(b, x.shape[1], heads, dh).transpose(0, 2, 1, 3)
+
+    qs, ks, vs = split(qp), split(kp), split(vp)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qs, ks) / jnp.sqrt(
+        jnp.asarray(dh, qp.dtype))
+    scores = jnp.where(k.mask()[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vs) \
+        .transpose(0, 2, 1, 3).reshape(b, t, d)
+    out = jnp.einsum("btd,de->bte", out, wo)
+    out = out * q.mask()[..., None].astype(out.dtype)
+    ctx.set_output("Out", RaggedPair(out, q.lengths))

@@ -12,6 +12,13 @@ from typing import Callable, Dict, List, Optional
 
 _counters = itertools.count()
 
+# Active recurrent_group frames (layer.py pushes/pops). Every Layer
+# constructed while a frame is active registers itself, so memory()
+# name-links can target ANY node built inside the step — including
+# secondary-output nodes (get_output of an lstm_step's cell state)
+# that are not ancestors of the step's returned output.
+RNN_STACK: list = []
+
 
 class Layer:
     """One node of the v2 layer graph.
@@ -30,6 +37,8 @@ class Layer:
         self.parents = [p for p in (parents or []) if p is not None]
         self._build = build
         self.size = size
+        if RNN_STACK:
+            RNN_STACK[-1].setdefault("nodes", []).append(self)
 
     # -- graph walking -------------------------------------------------
     def ancestors(self) -> List["Layer"]:

@@ -1190,7 +1190,8 @@ class StaticInput:
         self.size = size
 
 
-_RNN_STACK: List[dict] = []
+from .config_base import RNN_STACK as _RNN_STACK  # shared with
+# config_base so Layer.__init__ can register in-step nodes
 
 
 def _in_parent_block(build_fn, ctx):
@@ -1277,7 +1278,9 @@ def recurrent_group(step, input, reverse=False, name=None):
                 out_var = out_node.to_var(ctx)
                 for link_name, mem_var in frame["memories"]:
                     target = None
-                    for n in out_node.ancestors():
+                    candidates = frame.get("nodes", []) + \
+                        out_node.ancestors()
+                    for n in candidates:
                         if n.name == link_name:
                             target = n
                     if target is None:

@@ -99,5 +99,291 @@ def sequence_conv_pool(input, context_len, hidden_size, act=None,
                          name=name)
 
 
+def img_conv_bn_pool(input, filter_size, num_filters, pool_size,
+                     pool_stride=None, act=None, num_channels=None,
+                     conv_padding=0, pool_type=None, name=None, **_kw):
+    """conv -> batch_norm -> pool (reference: img_conv_bn_pool,
+    trainer_config_helpers/networks.py:231)."""
+    conv = layer.img_conv(input=input, filter_size=filter_size,
+                          num_filters=num_filters,
+                          num_channels=num_channels,
+                          padding=conv_padding, act=None,
+                          name=name and f"{name}_conv")
+    bn = layer.batch_norm(input=conv, act=act or Relu(),
+                          name=name and f"{name}_bn")
+    return layer.img_pool(input=bn, pool_size=pool_size,
+                          stride=pool_stride or pool_size,
+                          pool_type=pool_type or _pooling.Max(),
+                          name=name and f"{name}_pool")
+
+
+def img_separable_conv(input, num_channels, num_out_channels,
+                       filter_size, stride=1, padding=0, act=None,
+                       name=None, **_kw):
+    """Depthwise conv (groups == channels) + 1x1 pointwise conv
+    (reference: img_separable_conv, networks.py:439)."""
+    depthwise = layer.img_conv(input=input, filter_size=filter_size,
+                               num_filters=num_channels,
+                               num_channels=num_channels,
+                               groups=num_channels, stride=stride,
+                               padding=padding, act=None,
+                               name=name and f"{name}_dw")
+    return layer.img_conv(input=depthwise, filter_size=1,
+                          num_filters=num_out_channels,
+                          num_channels=num_channels, act=act,
+                          name=name and f"{name}_pw")
+
+
+def small_vgg(input_image, num_channels, num_classes):
+    """The CIFAR-sized VGG of the reference demos (networks.py:517)."""
+    tmp = input_image
+    channels = num_channels
+    for i, nf in enumerate((64, 128, 256, 512)):
+        reps = 2 if i < 2 else 3
+        tmp = img_conv_group(input=tmp, conv_num_filter=[nf] * reps,
+                             num_channels=channels,
+                             conv_batchnorm=True)
+        channels = None
+    from .activation import Softmax
+    tmp = layer.dropout(input=tmp, dropout_rate=0.5)
+    tmp = layer.fc(input=tmp, size=512, act=None)
+    tmp = layer.batch_norm(input=tmp, act=Relu())
+    tmp = layer.dropout(input=tmp, dropout_rate=0.5)
+    return layer.fc(input=tmp, size=num_classes, act=Softmax())
+
+
+def vgg_16_network(input_image, num_channels, num_classes=1000):
+    """VGG-16 (reference: networks.py:547)."""
+    from .activation import Softmax
+    tmp = input_image
+    channels = num_channels
+    for i, nf in enumerate((64, 128, 256, 512, 512)):
+        reps = 2 if i < 2 else 3
+        tmp = img_conv_group(input=tmp, conv_num_filter=[nf] * reps,
+                             num_channels=channels)
+        channels = None
+    for _ in range(2):
+        tmp = layer.fc(input=tmp, size=4096, act=Relu())
+        tmp = layer.dropout(input=tmp, dropout_rate=0.5)
+    return layer.fc(input=tmp, size=num_classes, act=Softmax())
+
+
+# ---------------------------------------------------------------------
+# step-level recurrent units/groups (reference: lstmemory_unit:717,
+# lstmemory_group:836, gru_unit:940, gru_group:1002, simple_gru2:1163,
+# bidirectional_gru:1226) — built on recurrent_group's name-linked
+# memory machinery
+# ---------------------------------------------------------------------
+
+import itertools as _it
+
+_unit_ids = _it.count()
+
+
+def lstmemory_unit(input, size, name=None, act=None, gate_act=None,
+                   state_act=None, param_attr=None, **_kw):
+    """One LSTM step for use INSIDE a recurrent_group step function:
+    declares h/c memories, projects [x, h_prev] to 4*size gates, and
+    links the next h/c by name (reference: lstmemory_unit)."""
+    nm = name or f"__lstm_unit_{next(_unit_ids)}__"
+    h_mem = layer.memory(name=f"{nm}_h", size=size)
+    c_mem = layer.memory(name=f"{nm}_c", size=size)
+    gates = layer.fc(input=[input, h_mem], size=size * 4,
+                     param_attr=param_attr, name=f"{nm}_gates")
+    h = layer.lstm_step(input=gates, state=c_mem, name=f"{nm}_h",
+                        act=act, gate_act=gate_act,
+                        state_act=state_act)
+    layer.get_output(input=h, arg_name="state", name=f"{nm}_c")
+    return h
+
+
+def lstmemory_group(input, size, name=None, act=None, gate_act=None,
+                    state_act=None, reverse=False, **_kw):
+    """recurrent_group over lstmemory_unit (reference:
+    lstmemory_group)."""
+    nm = name or f"__lstm_group_{next(_unit_ids)}__"
+
+    def step(x):
+        return lstmemory_unit(input=x, size=size, name=f"{nm}_unit",
+                              act=act, gate_act=gate_act,
+                              state_act=state_act)
+
+    return layer.recurrent_group(step=step, input=input,
+                                 reverse=reverse, name=nm)
+
+
+def gru_unit(input, size=None, name=None, act=None, gate_act=None,
+             param_attr=None, **_kw):
+    """One GRU step for use inside a recurrent_group step (reference:
+    gru_unit): input already carries the 3*size projection."""
+    if not size:
+        raise ValueError("gru_unit needs `size` (the hidden width the "
+                         "step memory is declared with)")
+    nm = name or f"__gru_unit_{next(_unit_ids)}__"
+    h_mem = layer.memory(name=f"{nm}_h", size=size)
+    return layer.gru_step(input=input, output_mem=h_mem, size=size,
+                          act=act, gate_act=gate_act,
+                          param_attr=param_attr, name=f"{nm}_h")
+
+
+def gru_group(input, size=None, name=None, act=None, gate_act=None,
+              reverse=False, **_kw):
+    nm = name or f"__gru_group_{next(_unit_ids)}__"
+
+    def step(x):
+        return gru_unit(input=x, size=size, name=f"{nm}_unit",
+                        act=act, gate_act=gate_act)
+
+    return layer.recurrent_group(step=step, input=input,
+                                 reverse=reverse, name=nm)
+
+
+def simple_gru2(input, size, name=None, act=None, gate_act=None,
+                reverse=False, **_kw):
+    """fc(3*size) + gru_group (reference simple_gru2 — the
+    step-composed variant of simple_gru)."""
+    proj = layer.fc(input=input, size=size * 3, bias_attr=False,
+                    name=name and f"{name}_proj")
+    return gru_group(input=proj, size=size, name=name, act=act,
+                     gate_act=gate_act, reverse=reverse)
+
+
+def bidirectional_gru(input, size, return_seq=True, name=None, **_kw):
+    fwd = simple_gru(input, size, reverse=False,
+                     name=name and f"{name}_fw")
+    bwd = simple_gru(input, size, reverse=True,
+                     name=name and f"{name}_bw")
+    if return_seq:
+        return layer.concat(input=[fwd, bwd])
+    return layer.concat(input=[layer.last_seq(fwd),
+                               layer.first_seq(bwd)])
+
+
+# ---------------------------------------------------------------------
+# attention (reference: simple_attention:1400,
+# dot_product_attention:1498, multi_head_attention:1580)
+# ---------------------------------------------------------------------
+
+def _node(type_, parents, build, name=None):
+    from .config_base import Layer as _Layer
+    return _Layer(type_, parents=parents, name=name, build=build)
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     name=None, **_kw):
+    """Bahdanau-style additive attention: project the decoder state to
+    the encoder-projection width (learned, as the reference's
+    full_matrix_projection does — so differing state/proj sizes work),
+    score each step, softmax within the sequence, weighted sum
+    (reference: simple_attention, networks.py:1400)."""
+    from .. import layers as F
+
+    def build_w(ctx):
+        proj_var = encoded_proj.to_var(ctx)
+        state_proj = F.fc(decoder_state.to_var(ctx),
+                          size=int(proj_var.shape[-1]),
+                          bias_attr=False)
+        return state_proj
+
+    state_node = _node("attention_state_proj",
+                       [encoded_proj, decoder_state], build_w,
+                       name=name and f"{name}_sp")
+    expanded = layer.expand(input=state_node, expand_as=encoded_proj)
+    both = layer.addto(input=[encoded_proj, expanded], act=Tanh())
+
+    def build_scores(ctx):
+        scores = F.fc(both.to_var(ctx), size=1, bias_attr=False)
+        return F.sequence_softmax(scores)
+
+    weights = _node("attention_weight", [both], build_scores,
+                    name=name and f"{name}_w")
+    scaled = layer.scaling(weight=weights, input=encoded_sequence)
+    return layer.pooling(input=scaled, pooling_type=_pooling.Sum(),
+                         name=name)
+
+
+def dot_product_attention(encoded_sequence, attended_sequence,
+                          transformed_state, softmax_param_attr=None,
+                          name=None, **_kw):
+    """score = <encoder_step, state> through a learned scalar scale
+    (softmax_param_attr names/initializes it, honoring the reference
+    signature); softmax; weighted sum of the attended sequence
+    (reference: dot_product_attention, networks.py:1498)."""
+    from .. import layers as F
+    from .layer import _pattr
+
+    expanded = layer.expand(input=transformed_state,
+                            expand_as=encoded_sequence)
+    nm = name or "dot_product_attention"
+
+    def build_s(ctx):
+        prod = F.elementwise_mul(encoded_sequence.to_var(ctx),
+                                 expanded.to_var(ctx))
+        s = F.reduce_sum(prod, dim=-1, keep_dim=True)
+        s = F.fc(s, size=1, bias_attr=False,
+                 param_attr=_pattr(softmax_param_attr, f"{nm}.w0"))
+        return F.sequence_softmax(s)
+
+    scores = _node("dot_scores", [encoded_sequence, expanded], build_s,
+                   name=name and f"{name}_scores")
+    scaled = layer.scaling(weight=scores, input=attended_sequence)
+    return layer.pooling(input=scaled, pooling_type=_pooling.Sum(),
+                         name=name)
+
+
+def multi_head_attention(query, key, value, head_num, name=None,
+                         **_kw):
+    """Multi-head attention over RAGGED sequence q/k/v (reference:
+    multi_head_attention, networks.py:1580 — the reference's inputs
+    are sequences too; attention runs within each sequence's valid
+    steps, per sample, never across the batch). One fused ragged op
+    (ops 'multihead_seq_attention') keeps the padding masking exact;
+    the modern dense transformer path lives in models/transformer.py."""
+    from .. import layers as F
+    from .layer import _raw_op
+
+    node = _node("multi_head_attention", [query, key, value], None,
+                 name=name)
+    nm = node.name
+
+    def build(ctx):
+        q = query.to_var(ctx)
+        k = key.to_var(ctx)
+        v = value.to_var(ctx)
+        d = int(q.shape[-1])
+        if d % head_num:
+            raise ValueError(f"d_model {d} not divisible by "
+                             f"{head_num} heads")
+        ws = {s: F.create_parameter([d, d], "float32",
+                                    name=f"{nm}.{s.lower()}")
+              for s in ("WQ", "WK", "WV", "WO")}
+        return _raw_op("multihead_seq_attention",
+                       {"Q": q, "K": k, "V": v, **ws},
+                       attrs={"num_heads": head_num},
+                       lod_out=("Out",))["Out"]
+
+    node._build = build
+    return node
+
+
+def inputs(layers_, *args):
+    """Legacy config marker (reference networks.py:1707): declares the
+    data order. The TPU-native Topology derives feeding order from the
+    graph, so this is a pass-through kept for script compatibility."""
+    return None
+
+
+def outputs(layers_, *args):
+    """Legacy output marker (reference networks.py:1725): in v2 the
+    output layers are whatever you hand to Topology/infer — returns
+    the input unchanged for script compatibility."""
+    return layers_
+
+
 __all__ = ["simple_img_conv_pool", "img_conv_group", "simple_lstm",
-           "bidirectional_lstm", "simple_gru", "sequence_conv_pool"]
+           "bidirectional_lstm", "simple_gru", "sequence_conv_pool",
+           "img_conv_bn_pool", "img_separable_conv", "small_vgg",
+           "vgg_16_network", "lstmemory_unit", "lstmemory_group",
+           "gru_unit", "gru_group", "simple_gru2", "bidirectional_gru",
+           "simple_attention", "dot_product_attention",
+           "multi_head_attention", "inputs", "outputs"]

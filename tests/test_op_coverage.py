@@ -800,3 +800,34 @@ def test_sequence_last_step_and_conv():
     np.testing.assert_allclose(t2.outputs()["Out"], np.stack(exp),
                                atol=1e-5, rtol=1e-4)
     t2.check_grad(["w"], max_relative_error=1e-2)
+
+
+def test_multihead_seq_attention():
+    """Ragged multi-head attention oracle: per-sequence softmax over
+    valid keys only; padding contributes nothing."""
+    heads, d = 2, 4
+    rp, seqs = _ragged([_r((n, d), 80 + n) for n in (3, 2)], 3)
+    r = np.random.RandomState(81)
+    wq, wk, wv, wo = (r.uniform(-0.5, 0.5, (d, d)).astype(np.float32)
+                      for _ in range(4))
+    t = OpTestHarness("multihead_seq_attention",
+                      {"Q": ("q", rp), "K": ("k", rp), "V": ("v", rp),
+                       "WQ": ("wq", wq), "WK": ("wk", wk),
+                       "WV": ("wv", wv), "WO": ("wo", wo)},
+                      attrs={"num_heads": heads})
+    got = t.outputs()["Out"]          # flat valid steps
+    exp = []
+    dh = d // heads
+    for s_ in seqs:
+        qp, kp, vp = s_ @ wq, s_ @ wk, s_ @ wv
+        outs = np.zeros_like(qp)
+        for h in range(heads):
+            sl = slice(h * dh, (h + 1) * dh)
+            sc = (qp[:, sl] @ kp[:, sl].T) / np.sqrt(dh)
+            p = np.exp(sc - sc.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            outs[:, sl] = p @ vp[:, sl]
+        exp.append(outs @ wo)
+    np.testing.assert_allclose(got, np.concatenate(exp), atol=1e-5,
+                               rtol=1e-4)
+    t.check_grad(["wo"], max_relative_error=1e-2)

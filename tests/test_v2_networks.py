@@ -1,0 +1,170 @@
+"""v2 composite networks at the reference surface (reference:
+python/paddle/trainer_config_helpers/networks.py — img_conv_bn_pool:231,
+img_separable_conv:439, small_vgg:517, lstmemory_unit:717,
+lstmemory_group:836, gru_unit:940, gru_group:1002, simple_gru2:1163,
+bidirectional_gru:1226, simple_attention:1400,
+dot_product_attention:1498, multi_head_attention:1580). Each composite
+must build and forward-run through Topology + infer; the recurrent
+groups must also TRAIN (grads through name-linked memories)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.v2 import activation, data_type, layer, networks
+
+
+def _v(d, seed=0):
+    return np.random.RandomState(seed).uniform(-1, 1, d) \
+        .astype(np.float32).tolist()
+
+
+def _seq(d, steps, seed=0):
+    r = np.random.RandomState(seed)
+    return [r.uniform(-1, 1, d).astype(np.float32).tolist()
+            for _ in range(steps)]
+
+
+def _infer(out, samples, feeding):
+    params = paddle.parameters.create(out)
+    res = paddle.infer(output_layer=out, parameters=params,
+                       input=samples, feeding=feeding)
+    arr = np.asarray(res)
+    assert arr.size > 0 and np.isfinite(arr).all()
+    return arr
+
+
+def test_img_conv_bn_pool_and_separable():
+    x = layer.data(name="x", type=data_type.dense_vector(3 * 8 * 8),
+                   height=8, width=8)
+    a = networks.img_conv_bn_pool(input=x, filter_size=3,
+                                  num_filters=4, pool_size=2,
+                                  num_channels=3, conv_padding=1)
+    b = networks.img_separable_conv(input=x, num_channels=3,
+                                    num_out_channels=6, filter_size=3,
+                                    padding=1,
+                                    act=activation.Relu())
+    _infer(a, [(_v(192, 1),)], {"x": 0})
+    _infer(b, [(_v(192, 2),)], {"x": 0})
+
+
+def test_small_vgg_builds_and_runs():
+    x = layer.data(name="x", type=data_type.dense_vector(3 * 32 * 32),
+                   height=32, width=32)
+    out = networks.small_vgg(input_image=x, num_channels=3,
+                             num_classes=10)
+    arr = _infer(out, [(_v(3 * 32 * 32, 3),)], {"x": 0})
+    assert arr.shape[-1] == 10
+    np.testing.assert_allclose(arr.sum(-1), 1.0, atol=1e-3)
+
+
+def test_lstmemory_group_trains():
+    """The name-linked h/c memories must carry state AND gradients:
+    a sequence-sum regression through lstmemory_group converges."""
+    x = layer.data(name="x", type=data_type.dense_vector_sequence(4))
+    y = layer.data(name="y", type=data_type.dense_vector(1))
+    rnn = networks.lstmemory_group(input=x, size=6)
+    pred = layer.fc(input=layer.last_seq(input=rnn), size=1)
+    cost = layer.mse_cost(input=pred, label=y)
+
+    params = paddle.parameters.create(cost)
+    opt = paddle.optimizer.Adam(learning_rate=0.05)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=params,
+                                 update_equation=opt)
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for i in range(48):
+            n = 2 + i % 3
+            steps = rng.uniform(-1, 1, (n, 4)).astype(np.float32)
+            yield steps.tolist(), [float(steps.sum())]
+
+    costs = []
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            costs.append(e.cost)
+
+    trainer.train(reader=paddle.batch(reader, batch_size=8),
+                  num_passes=6, event_handler=handler,
+                  feeding={"x": 0, "y": 1})
+    assert costs[-1] < costs[0] * 0.5, (costs[0], costs[-1])
+
+
+def test_gru_group_and_simple_gru2_run():
+    x = layer.data(name="x", type=data_type.dense_vector_sequence(9))
+    out = networks.gru_group(input=x, size=3)
+    _infer(layer.last_seq(input=out),
+           [(_seq(9, 3, 1),), (_seq(9, 2, 2),)], {"x": 0})
+
+    x2 = layer.data(name="x", type=data_type.dense_vector_sequence(5))
+    out2 = networks.simple_gru2(input=x2, size=4)
+    _infer(layer.last_seq(input=out2), [(_seq(5, 3, 3),)], {"x": 0})
+
+
+def test_bidirectional_gru():
+    x = layer.data(name="x", type=data_type.dense_vector_sequence(6))
+    out = networks.bidirectional_gru(input=x, size=3,
+                                     return_seq=False)
+    arr = _infer(out, [(_seq(6, 4, 4),)], {"x": 0})
+    assert arr.shape[-1] == 6  # fw+bw concat
+
+
+def test_simple_attention_differing_state_size():
+    """The decoder state passes through a LEARNED projection, so its
+    width may differ from the encoder projection's (the reference's
+    full_matrix_projection behavior)."""
+    enc = layer.data(name="enc",
+                     type=data_type.dense_vector_sequence(8))
+    state = layer.data(name="state", type=data_type.dense_vector(5))
+    ctx = networks.simple_attention(encoded_sequence=enc,
+                                    encoded_proj=enc,
+                                    decoder_state=state)
+    arr = _infer(ctx, [(_seq(8, 4, 5), _v(5, 6))],
+                 {"enc": 0, "state": 1})
+    assert arr.shape[-1] == 8  # weighted sum keeps the feature dim
+
+
+def test_dot_product_attention():
+    enc = layer.data(name="enc",
+                     type=data_type.dense_vector_sequence(6))
+    state = layer.data(name="state", type=data_type.dense_vector(6))
+    ctx = networks.dot_product_attention(encoded_sequence=enc,
+                                         attended_sequence=enc,
+                                         transformed_state=state)
+    arr = _infer(ctx, [(_seq(6, 3, 7), _v(6, 8))],
+                 {"enc": 0, "state": 1})
+    assert arr.shape[-1] == 6
+
+
+def test_multi_head_attention_per_sample_invariance():
+    """Attention runs WITHIN each sequence: a sample's output must not
+    change when it is batched with a different second sample."""
+    def run(samples):
+        x = layer.data(name="x",
+                       type=data_type.dense_vector_sequence(8))
+        out = networks.multi_head_attention(query=x, key=x, value=x,
+                                            head_num=2, name="mha")
+        params = paddle.parameters.create(out)
+        w = np.random.RandomState(1).uniform(
+            -0.3, 0.3, (8, 8)).astype(np.float32)
+        for slot in ("wq", "wk", "wv", "wo"):
+            params.set(f"mha.{slot}", w)
+        return np.asarray(paddle.infer(output_layer=out,
+                                       parameters=params,
+                                       input=samples,
+                                       feeding={"x": 0}))
+
+    s1 = _seq(8, 3, 20)
+    s2 = _seq(8, 3, 21)
+    solo = run([(s1,)])
+    batched = run([(s1,), (s2,)])
+    np.testing.assert_allclose(batched[:3], solo, atol=1e-5,
+                               rtol=1e-4)
+
+
+def test_inputs_outputs_markers():
+    x = layer.data(name="x", type=data_type.dense_vector(4))
+    assert networks.inputs([x]) is None
+    assert networks.outputs(x) is x
