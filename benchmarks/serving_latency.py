@@ -1,0 +1,133 @@
+"""Closed-loop serving latency/throughput harness.
+
+Freezes an MNIST-sized MLP with save_inference_model, serves it through
+paddle_tpu.serving (dynamic batching + bucketed executable cache), then
+drives it with N closed-loop clients (each submits, waits, submits
+again) for a fixed duration and prints one JSON report: throughput,
+client-observed latency percentiles, batch fill ratio, and the
+compile-cache hit rate that the bucketing exists to maximize.
+
+    python benchmarks/serving_latency.py --clients 8 --duration 10 \
+        --max_batch 32 --max_latency_ms 5
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def freeze_mlp(dirname, in_dim=784, hidden=256, classes=10):
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = 0
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [in_dim], dtype="float32")
+        h = layers.fc(x, size=hidden, act="relu")
+        pred = layers.fc(h, size=classes, act="softmax")
+    exe = pt.Executor()
+    exe.run(startup)
+    pt.io.save_inference_model(dirname, ["x"], [pred], exe, main)
+    return dirname
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--clients", type=int, default=8,
+                   help="closed-loop client threads")
+    p.add_argument("--duration", type=float, default=10.0,
+                   help="measured seconds (after warmup)")
+    p.add_argument("--rows", type=int, default=1,
+                   help="rows per request")
+    p.add_argument("--max_batch", type=int, default=32)
+    p.add_argument("--max_latency_ms", type=float, default=5.0)
+    p.add_argument("--in_dim", type=int, default=784)
+    args = p.parse_args()
+
+    from paddle_tpu import serving
+
+    model_dir = tempfile.mkdtemp(prefix="serving_bench_")
+    freeze_mlp(model_dir, in_dim=args.in_dim)
+    model = serving.load(model_dir)
+    engine = model.serve(serving.BatchingConfig(
+        max_batch_size=args.max_batch,
+        max_latency_ms=args.max_latency_ms,
+        queue_capacity_rows=max(4096, 4 * args.max_batch)))
+    t0 = time.monotonic()
+    engine.start(warmup=True)  # precompile every batch bucket
+    warmup_s = time.monotonic() - t0
+
+    stop_flag = threading.Event()
+    lat_lock = threading.Lock()
+    latencies, completed, failed = [], [0], [0]
+
+    def client(seed):
+        rng = np.random.RandomState(seed)
+        x = rng.rand(args.rows, args.in_dim).astype(np.float32)
+        while not stop_flag.is_set():
+            t = time.monotonic()
+            try:
+                engine.predict({"x": x}, timeout=60)
+            except Exception:
+                with lat_lock:
+                    failed[0] += 1
+                continue
+            dt = time.monotonic() - t
+            with lat_lock:
+                latencies.append(dt)
+                completed[0] += 1
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.clients)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(args.duration)
+    stop_flag.set()
+    for t in threads:
+        t.join(timeout=120)
+    elapsed = time.monotonic() - t_start
+    engine.stop(drain=True, timeout=120)
+
+    lat = np.asarray(latencies) if latencies else np.zeros(1)
+    stats = engine.stats()
+    report = {
+        "benchmark": "serving_latency",
+        "clients": args.clients,
+        "rows_per_request": args.rows,
+        "max_batch": args.max_batch,
+        "max_latency_ms": args.max_latency_ms,
+        "duration_s": round(elapsed, 3),
+        "warmup_s": round(warmup_s, 3),
+        "requests_completed": completed[0],
+        "requests_failed": failed[0],
+        "throughput_rps": round(completed[0] / elapsed, 2),
+        "throughput_rows_per_s": round(
+            completed[0] * args.rows / elapsed, 2),
+        "latency_ms": {
+            "p50": round(float(np.percentile(lat, 50)) * 1e3, 3),
+            "p90": round(float(np.percentile(lat, 90)) * 1e3, 3),
+            "p99": round(float(np.percentile(lat, 99)) * 1e3, 3),
+            "mean": round(float(lat.mean()) * 1e3, 3),
+        },
+        "batch_fill_ratio_p50": stats["batch_fill_ratio"]["p50"],
+        "batches": stats["batches"],
+        "compile_cache": stats["compile_cache"],
+        "warmup_compiles": stats["warmup_compiles"],
+    }
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import atexit
 import os
+import threading
 import warnings
 import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -66,21 +67,35 @@ def _check_while_flag(key, value, raise_: bool):
 # freezes its outputs when constructed with freeze=True.
 _feed_cache: Dict[int, Tuple[Any, Any]] = {}
 _FEED_CACHE_MAX = int(os.environ.get("PADDLE_TPU_FEED_CACHE_MAX", "8"))
+# The cache is shared process-wide and executors now run from multiple
+# threads (serving workers co-resident with a training loop), so the
+# pop/re-insert LRU dance and eviction must be atomic.
+_feed_cache_lock = threading.Lock()
 
 
 def _cached_device_put(arr: np.ndarray):
     key = id(arr)
-    hit = _feed_cache.get(key)
-    if hit is not None and hit[0]() is arr:
-        return hit[1]
+    with _feed_cache_lock:
+        hit = _feed_cache.get(key)
+        if hit is not None and hit[0]() is arr:
+            # LRU: re-insert on hit so steady reuse (e.g. a validation
+            # batch fed every step alongside rotating train batches) is
+            # never the eviction victim just because it was inserted
+            # first.
+            _feed_cache.pop(key, None)
+            _feed_cache[key] = hit
+            return hit[1]
     dev = jnp.asarray(arr)
     try:
         ref = weakref.ref(arr, lambda _r, k=key: _feed_cache.pop(k, None))
-        # Bounded: evict oldest so an epoch of precomputed frozen batches
-        # can't pin one device copy per batch for the epoch's lifetime.
-        while len(_feed_cache) >= _FEED_CACHE_MAX:
-            _feed_cache.pop(next(iter(_feed_cache)))
-        _feed_cache[key] = (ref, dev)
+        with _feed_cache_lock:
+            # Bounded: evict least-recently-used (dicts iterate in
+            # insertion order; hits re-insert) so an epoch of
+            # precomputed frozen batches can't pin one device copy per
+            # batch for the epoch's lifetime.
+            while len(_feed_cache) >= _FEED_CACHE_MAX:
+                _feed_cache.pop(next(iter(_feed_cache)))
+            _feed_cache[key] = (ref, dev)
     except TypeError:
         pass
     return dev
@@ -164,6 +179,15 @@ def _abstractify(value):
         return ("raggedk", len(value.lengths), value.data.shape,
                 str(value.data.dtype))
     return (tuple(value.shape), str(value.dtype))
+
+
+def feed_signature(feed_vals) -> Tuple:
+    """Hashable (name, abstract shape/dtype) signature of a feed dict —
+    the per-request part of the executor's compile-cache key. Feed values
+    must already be in device form (`_to_device_value`); plain
+    numpy/ndarray-likes with .shape/.dtype also work. Serving uses this
+    to predict whether a padded batch will reuse an existing executable."""
+    return tuple(sorted((k, _abstractify(v)) for k, v in feed_vals.items()))
 
 
 def trace_block(block: BlockDesc, env: Dict[str, Any],
@@ -373,7 +397,30 @@ class Executor:
         # one step later so the warn-by-default path never forces a
         # device sync on the just-dispatched step
         self._deferred_flags: List[Tuple[Tuple, Any]] = []
+        # compile-cache hit/miss counters: a hit means run() dispatched
+        # an already-jitted executable; a miss means it traced+compiled.
+        # Serving reads these for its compile_cache_hit_rate metric.
+        self.cache_stats: Dict[str, int] = {"hits": 0, "misses": 0}
         _LIVE_EXECUTORS.add(self)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def compile_key(program, feed_sig, fetch_names, block_idx: int = 0,
+                    while_bounds=None, iterations: int = 1,
+                    stacked_feed: bool = False, amp=None) -> Tuple:
+        """The compile-cache key for one (program, feed signature, fetch
+        list) combination — the public form of the private cache tuple,
+        so callers (serving warmup, cache probes) can reason about
+        executable reuse without duplicating the key layout. `feed_sig`
+        comes from `feed_signature`; `amp=None` reads the ambient AMP
+        state, matching what run() would use."""
+        if hasattr(program, "desc"):
+            program = program.desc
+        return (program.uid, program.version, feed_sig,
+                tuple(fetch_names), block_idx,
+                amp_enabled() if amp is None else bool(amp),
+                tuple(sorted(while_bounds.items())) if while_bounds
+                else None, iterations, stacked_feed)
 
     # ------------------------------------------------------------------
     def _probe_while_bounds(self, program: Program, block: BlockDesc,
@@ -581,8 +628,7 @@ class Executor:
         fetch_names = fetch_names + exhausted
 
         feed_vals = {k: _to_device_value(v) for k, v in feed.items()}
-        feed_sig = tuple(sorted((k, _abstractify(v))
-                                for k, v in feed_vals.items()))
+        feed_sig = feed_signature(feed_vals)
         step = scope.find(STEP_VAR)
         if step is None:
             step = jnp.zeros((), jnp.int32)
@@ -636,12 +682,13 @@ class Executor:
                     "callbacks inside a compiled scan are unverified. Run "
                     "steps one at a time.")
 
-        key = (program.uid, program.version, feed_sig, tuple(fetch_names),
-               block_idx, amp_enabled(),
-               tuple(sorted(while_bounds.items())) if while_bounds
-               else None, iterations, stacked_feed)
+        key = self.compile_key(program, feed_sig, fetch_names, block_idx,
+                               while_bounds=while_bounds,
+                               iterations=iterations,
+                               stacked_feed=stacked_feed)
         compiled = self._cache.get(key)
         if compiled is None:
+            self.cache_stats["misses"] += 1
             kw = {} if iterations == 1 else {
                 "iterations": iterations,
                 "or_reduce_tail": len(exhausted),
@@ -650,6 +697,8 @@ class Executor:
                                      scope, while_bounds=while_bounds,
                                      **kw)
             self._cache[key] = compiled
+        else:
+            self.cache_stats["hits"] += 1
 
         state_vals = {n: scope.get(n) for n in compiled.read_names}
         # kept for AOT introspection (profiler cost analysis, the
@@ -673,8 +722,8 @@ class Executor:
             # warn mode: check the previous step's flags (long since
             # computed — reading them does not stall this step) and
             # defer this step's to the next call / close()
-            for key, v in self._deferred_flags:
-                _check_while_flag(key, v, raise_=False)
+            for fkey, v in self._deferred_flags:
+                _check_while_flag(fkey, v, raise_=False)
             self._deferred_flags = [((program.uid, n), v)
                                     for n, v in flag_vals]
         if CHECK_NAN_INF:

@@ -19,8 +19,28 @@ from .framework import Program, default_main_program
 
 __all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
            "load_params", "load_persistables", "save_inference_model",
-           "load_inference_model", "get_parameter_value",
-           "set_parameter_value"]
+           "load_inference_model", "inference_model_specs",
+           "get_parameter_value", "set_parameter_value"]
+
+
+def inference_model_specs(program: Program, feed_names, fetch_names):
+    """Per-var feed/fetch metadata {name: {shape, dtype, lod_level}} for a
+    frozen program. -1 dims are dynamic (leading -1 is the batch axis) —
+    this is what serving's batcher buckets on. Derived from the program's
+    VarDescs so it works for models saved before specs were written."""
+    # accept the python builder wrapper (global_block is a METHOD there)
+    # or the core ir.Program (global_block is a property)
+    block = program.global_block() if hasattr(program, "desc") \
+        else program.blocks[0]
+
+    def spec(name):
+        v = block.var(name)
+        v = v.desc if hasattr(v, "desc") else v
+        return {"shape": list(v.shape) if v.shape is not None else None,
+                "dtype": v.dtype, "lod_level": v.lod_level}
+
+    return ({n: spec(n) for n in feed_names},
+            {n: spec(n) for n in fetch_names})
 
 
 def _vars_of(program: Program, predicate) -> List:
@@ -97,6 +117,14 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     meta = dict(pruned.desc.to_dict())  # top-level "blocks" + extras
     meta["feed_names"] = list(feeded_var_names)
     meta["fetch_names"] = fetch_names
+    # Feed/fetch shape+dtype metadata, so a serving frontend can bucket
+    # batches without reconstructing the program first. Best-effort on
+    # disk (the native PTIR writer may drop unknown top-level keys);
+    # load_inference_model re-derives it from VarDescs when absent.
+    feed_specs, fetch_specs = inference_model_specs(
+        pruned, feeded_var_names, fetch_names)
+    meta["feed_specs"] = feed_specs
+    meta["fetch_specs"] = fetch_specs
     try:
         from .native import ProgramIR
         ProgramIR.from_json(json.dumps(meta)).save(
@@ -112,7 +140,11 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 
 
 def load_inference_model(dirname, executor, model_filename=None,
-                         params_filename=None):
+                         params_filename=None, return_meta=False):
+    """Load a frozen model. Returns (program, feed_names, fetch_vars);
+    with return_meta=True a 4th element carries bucketing metadata
+    {"feed_specs": {...}, "fetch_specs": {...}} (shape/dtype/lod_level
+    per var — see `inference_model_specs`)."""
     bin_path = os.path.join(dirname, model_filename or "__model__")
     json_path = os.path.join(dirname, model_filename or "__model__.json")
     meta = None
@@ -128,7 +160,8 @@ def load_inference_model(dirname, executor, model_filename=None,
         with open(json_path) as f:
             meta = json.load(f)
         meta = meta.get("program", meta) | {
-            k: meta[k] for k in ("feed_names", "fetch_names") if k in meta}
+            k: meta[k] for k in ("feed_names", "fetch_names",
+                                 "feed_specs", "fetch_specs") if k in meta}
     from .core import ir
     prog = Program()
     prog.desc = ir.Program.from_dict(meta)
@@ -138,7 +171,15 @@ def load_inference_model(dirname, executor, model_filename=None,
               predicate=lambda v: v.persistable,
               filename=params_filename or "__params__.npz")
     fetch_vars = [prog.global_block().var(n) for n in meta["fetch_names"]]
-    return prog, meta["feed_names"], fetch_vars
+    if not return_meta:
+        return prog, meta["feed_names"], fetch_vars
+    if "feed_specs" in meta and "fetch_specs" in meta:
+        feed_specs, fetch_specs = meta["feed_specs"], meta["fetch_specs"]
+    else:  # saved before specs were written, or dropped by the PTIR writer
+        feed_specs, fetch_specs = inference_model_specs(
+            prog, meta["feed_names"], meta["fetch_names"])
+    return prog, meta["feed_names"], fetch_vars, {
+        "feed_specs": feed_specs, "fetch_specs": fetch_specs}
 
 
 def _prune(program: Program, feed_names, fetch_names) -> Program:

@@ -16,12 +16,19 @@ from typing import Dict, List, Optional
 _events: List[Dict] = []
 _enabled = False
 
+# Event categories ("cat" in the chrome-trace schema). Host events from
+# the serving runtime (paddle_tpu.serving) are tagged so a trace of a
+# live server separates queueing/batching/compile time from model time.
+CAT_SERVING = "serving"
+
 
 class RecordEvent:
-    """RAII event (reference: profiler.h:106)."""
+    """RAII event (reference: profiler.h:106). `cat` is an optional
+    chrome-trace category (e.g. CAT_SERVING) used to filter summaries."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, cat: Optional[str] = None):
         self.name = name
+        self.cat = cat
         self.t0 = None
 
     def __enter__(self):
@@ -30,10 +37,18 @@ class RecordEvent:
 
     def __exit__(self, *exc):
         if _enabled:
-            _events.append({"name": self.name, "ts": self.t0 * 1e6,
-                            "dur": (time.perf_counter() - self.t0) * 1e6,
-                            "ph": "X", "pid": 0, "tid": 0})
+            ev = {"name": self.name, "ts": self.t0 * 1e6,
+                  "dur": (time.perf_counter() - self.t0) * 1e6,
+                  "ph": "X", "pid": 0, "tid": 0}
+            if self.cat:
+                ev["cat"] = self.cat
+            _events.append(ev)
         return False
+
+
+def events(cat: Optional[str] = None) -> List[Dict]:
+    """Snapshot of recorded host events, optionally filtered by category."""
+    return [e for e in _events if cat is None or e.get("cat") == cat]
 
 
 def start_profiler(state: str = "All"):
@@ -51,9 +66,11 @@ def stop_profiler(sorted_key: Optional[str] = None,
     return summary()
 
 
-def summary():
+def summary(cat: Optional[str] = None):
     agg: Dict[str, Dict] = {}
     for e in _events:
+        if cat is not None and e.get("cat") != cat:
+            continue
         a = agg.setdefault(e["name"], {"calls": 0, "total_us": 0.0})
         a["calls"] += 1
         a["total_us"] += e["dur"]

@@ -1,0 +1,39 @@
+"""paddle_tpu.serving — in-process inference serving with dynamic
+batching and a bucketed-shape executable cache.
+
+Quickstart::
+
+    # freeze (training side)
+    io.save_inference_model("model_dir", ["x"], [pred], exe, main_prog)
+
+    # serve
+    from paddle_tpu import serving
+    model = serving.load("model_dir")
+    engine = model.serve(serving.BatchingConfig(max_batch_size=32,
+                                                max_latency_ms=5.0))
+    engine.start()                     # warms one executable per bucket
+    out, = model.predict({"x": batch})  # dynamic-batched under the hood
+    print(engine.stats())              # JSON-able metrics snapshot
+    engine.stop()                      # drains in-flight requests
+
+Module map: `model.ServableModel` (frozen program + pinned weights),
+`batcher.DynamicBatcher` (bucket padding, deadline/max-batch flush,
+backpressure), `engine.ServingEngine` (workers, warmup, drain),
+`metrics.ServingMetrics` (counters/histograms + stats()).
+"""
+from .batcher import (BatchingConfig, DynamicBatcher,  # noqa
+                      QueueFullError, ServingFuture, ServingStopped)
+from .engine import ServingEngine  # noqa
+from .metrics import ServingMetrics  # noqa
+from .model import ServableModel  # noqa
+
+__all__ = ["load", "ServableModel", "ServingEngine", "ServingMetrics",
+           "BatchingConfig", "DynamicBatcher", "ServingFuture",
+           "QueueFullError", "ServingStopped"]
+
+
+def load(dirname, model_filename=None, params_filename=None):
+    """Load a `save_inference_model` directory into a ServableModel with
+    its own scope, device-pinned weights, and executor."""
+    return ServableModel.load(dirname, model_filename=model_filename,
+                              params_filename=params_filename)

@@ -1,0 +1,177 @@
+"""ServingEngine: worker threads draining the dynamic batcher into the
+executor's bucketed compile cache.
+
+Lifecycle: `start()` (optionally warmup-precompiling one executable per
+batch bucket) -> clients `submit()`/`predict()` -> `stop()` closes the
+front door and drains every in-flight batch before joining workers.
+
+The engine deliberately owns no compilation machinery of its own: it
+reuses `core/executor.py`'s CompiledProgram cache. Because the batcher
+pads every flush to a bucket shape, the executor sees a small closed set
+of feed signatures and `Executor.compile_key` collisions become cache
+hits — compile once per bucket, serve forever (the serving-era
+amortize-compilation design; see batcher.py docstring).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .. import profiler
+from .batcher import Batch, BatchingConfig, DynamicBatcher, ServingFuture
+from .metrics import ServingMetrics
+
+__all__ = ["ServingEngine"]
+
+
+class ServingEngine:
+    def __init__(self, model, config: Optional[BatchingConfig] = None,
+                 metrics: Optional[ServingMetrics] = None,
+                 num_workers: int = 1):
+        self.model = model
+        self.config = config or BatchingConfig()
+        self.metrics = metrics or ServingMetrics()
+        self.batcher = DynamicBatcher(model.feed_specs, self.config,
+                                      self.metrics)
+        self.num_workers = int(num_workers)
+        # per-row vs batch-level fetch split decided from the STATIC
+        # fetch specs (leading -1 = batched): a runtime shape check
+        # alone would misclassify a batch-level fetch whose leading dim
+        # happens to equal the bucket size. None = spec shape unknown,
+        # fall back to the runtime check.
+        self._per_row_fetch = []
+        for name in model.fetch_names:
+            shape = (model.fetch_specs.get(name) or {}).get("shape")
+            self._per_row_fetch.append(
+                None if shape is None else bool(shape and shape[0] == -1))
+        self._threads = []
+        self._started = False
+        self._stopped = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, warmup: bool = True):
+        if self._started:
+            raise RuntimeError("engine already started")
+        if self._stopped:
+            raise RuntimeError("engine was stopped; build a new one")
+        if warmup:
+            self.warmup()
+        for i in range(self.num_workers):
+            t = threading.Thread(target=self._worker_loop,
+                                 name=f"serving-worker-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        self._started = True
+        # only a RUNNING engine captures model.predict; before start /
+        # after stop, predict falls back to a direct run
+        self.model._engine = self
+        return self
+
+    def warmup(self):
+        """Precompile one executable per batch bucket by running a zero
+        batch through the model, so the first real request in any bucket
+        pays dispatch, not tracing+XLA compilation. Dynamic non-batch
+        dims warm at the smallest seq bucket only (other seq buckets
+        compile on first use)."""
+        with profiler.RecordEvent("serving::warmup",
+                                  cat=profiler.CAT_SERVING):
+            for rows in self.config.batch_buckets:
+                feed = self._zero_feed(rows)
+                before = self.model.executor.cache_stats["misses"]
+                self.model.run_direct(feed)
+                self.metrics.warmup_compiles.inc(
+                    self.model.executor.cache_stats["misses"] - before)
+
+    def _zero_feed(self, rows: int) -> Dict[str, np.ndarray]:
+        seq = self.config.seq_buckets[0] if self.config.seq_buckets else 1
+        feed = {}
+        for name, spec in self.model.feed_specs.items():
+            shape = [rows] + [seq if d == -1 else d
+                              for d in spec["shape"][1:]]
+            feed[name] = np.zeros(shape, dtype=np.dtype(spec["dtype"]))
+        return feed
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None):
+        """Stop accepting requests; with drain=True (default) every
+        queued and in-flight request completes before workers exit, so
+        no accepted request is dropped."""
+        self.batcher.close(drain=drain)
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        for t in self._threads:
+            t.join(timeout=None if deadline is None
+                   else max(0.0, deadline - time.monotonic()))
+        self._threads = [t for t in self._threads if t.is_alive()]
+        if self._threads:
+            raise TimeoutError(
+                f"{len(self._threads)} serving worker(s) still draining "
+                "after timeout")
+        self._stopped = True
+        if self.model._engine is self:
+            self.model._engine = None
+
+    # -- request path ------------------------------------------------------
+    def submit(self, feed: Dict[str, Any]) -> ServingFuture:
+        if not self._started:
+            raise RuntimeError(
+                "engine not started — call engine.start() first "
+                "(a request submitted now would wait forever)")
+        return self.batcher.submit(feed)
+
+    def predict(self, feed: Dict[str, Any],
+                timeout: Optional[float] = None):
+        """Synchronous predict: submit + wait. Returns the fetch list for
+        exactly this request's rows (padding stripped)."""
+        return self.submit(feed).result(timeout=timeout)
+
+    # -- observability -----------------------------------------------------
+    def stats(self) -> Dict:
+        """JSON-able snapshot: request/batch counters, fill ratio,
+        latency percentiles, queue depth, compile-cache hit rate."""
+        out = self.metrics.stats(executor=self.model.executor)
+        out["batch_buckets"] = list(self.config.batch_buckets)
+        out["seq_buckets"] = (list(self.config.seq_buckets)
+                              if self.config.seq_buckets else None)
+        out["workers"] = len(self._threads)
+        out["started"] = self._started
+        out["stopped"] = self._stopped
+        return out
+
+    # -- worker ------------------------------------------------------------
+    def _worker_loop(self):
+        while True:
+            batch = self.batcher.next_batch()
+            if batch is None:
+                return
+            self._run_batch(batch)
+
+    def _run_batch(self, batch: Batch):
+        t0 = time.monotonic()
+        try:
+            with profiler.RecordEvent(
+                    f"serving::batch_run[{batch.bucket_rows}]",
+                    cat=profiler.CAT_SERVING):
+                fetches = self.model.run_direct(batch.feed)
+        except BaseException as e:  # deliver failures, keep serving
+            self.metrics.errors.inc(len(batch.requests))
+            for req in batch.requests:
+                req.future.set_exception(e)
+            return
+        t1 = time.monotonic()
+        for req, (i0, i1) in zip(batch.requests, batch.slices):
+            out = []
+            for f, per_row in zip(fetches, self._per_row_fetch):
+                arr = np.asarray(f)
+                # per-row fetches are sliced back to the request's rows;
+                # batch-level fetches (scalars / no leading batch axis)
+                # are delivered whole
+                if per_row is None:  # unknown static shape
+                    per_row = arr.ndim >= 1 and \
+                        arr.shape[0] == batch.bucket_rows
+                out.append(arr[i0:i1] if per_row else arr)
+            self.metrics.queue_wait_s.record(t0 - req.t_submit)
+            self.metrics.latency_s.record(t1 - req.t_submit)
+            req.future.set_result(out)

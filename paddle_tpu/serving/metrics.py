@@ -1,0 +1,141 @@
+"""Serving metrics: thread-safe counters/gauges/histograms plus a
+`stats()` JSON snapshot.
+
+Design notes: histograms keep a bounded reservoir (most-recent window)
+so percentiles track current behaviour and memory stays O(window) under
+sustained traffic. Host-side timing additionally flows through
+`profiler.RecordEvent(..., cat=profiler.CAT_SERVING)` in the engine, so
+a chrome trace of a live server separates queueing/batching from model
+time (the serving analog of the reference's RecordEvent tables)."""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+from typing import Deque, Dict, Optional
+
+import numpy as np
+
+
+class Counter:
+    """Monotonic counter."""
+
+    def __init__(self):
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1):
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self) -> int:
+        return self._v
+
+
+class Gauge:
+    """Last-set value (e.g. queue depth sampled at submit time)."""
+
+    def __init__(self):
+        self._v = 0.0
+
+    def set(self, v: float):
+        self._v = float(v)
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+
+class Histogram:
+    """Bounded-reservoir histogram: records the most recent `window`
+    observations and answers percentile queries over them."""
+
+    def __init__(self, window: int = 8192):
+        self._vals: Deque[float] = collections.deque(maxlen=window)
+        self._count = 0
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, v: float):
+        with self._lock:
+            self._vals.append(float(v))
+            self._count += 1
+            self._sum += float(v)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            if not self._vals:
+                return 0.0
+            return float(np.percentile(np.asarray(self._vals), p))
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            vals = np.asarray(self._vals) if self._vals else None
+        if vals is None:
+            return {"count": self._count, "mean": 0.0,
+                    "p50": 0.0, "p90": 0.0, "p99": 0.0}
+        p50, p90, p99 = np.percentile(vals, [50, 90, 99])
+        return {"count": self._count, "mean": round(self.mean, 6),
+                "p50": round(float(p50), 6), "p90": round(float(p90), 6),
+                "p99": round(float(p99), 6)}
+
+
+class ServingMetrics:
+    """All serving-side observability in one place.
+
+    - requests/rejections/timeouts/errors: request-level counters
+    - batches: batch-level counter; batch_fill_ratio: real rows / bucket
+      rows per flushed batch (1.0 = no padding waste)
+    - queue_depth: rows waiting, sampled on every submit/flush
+    - latency_s: request wall time submit -> result
+    - compile cache hits/misses come from the engine's Executor
+      (`Executor.cache_stats`) at snapshot time
+    """
+
+    def __init__(self):
+        self.requests = Counter()
+        self.rejected = Counter()
+        self.timeouts = Counter()
+        self.errors = Counter()
+        self.batches = Counter()
+        self.warmup_compiles = Counter()
+        self.queue_depth = Gauge()
+        self.batch_fill_ratio = Histogram()
+        self.batch_rows = Histogram()
+        self.latency_s = Histogram()
+        self.queue_wait_s = Histogram()
+
+    def stats(self, executor=None) -> Dict:
+        """JSON-able snapshot; pass the engine's Executor to fold in
+        compile-cache hit/miss counters."""
+        out = {
+            "requests": self.requests.value,
+            "rejected": self.rejected.value,
+            "timeouts": self.timeouts.value,
+            "errors": self.errors.value,
+            "batches": self.batches.value,
+            "warmup_compiles": self.warmup_compiles.value,
+            "queue_depth": self.queue_depth.value,
+            "batch_fill_ratio": self.batch_fill_ratio.snapshot(),
+            "batch_rows": self.batch_rows.snapshot(),
+            "latency_s": self.latency_s.snapshot(),
+            "queue_wait_s": self.queue_wait_s.snapshot(),
+        }
+        if executor is not None:
+            cs = dict(executor.cache_stats)
+            total = cs["hits"] + cs["misses"]
+            cs["hit_rate"] = round(cs["hits"] / total, 6) if total else 0.0
+            out["compile_cache"] = cs
+        return out
+
+    def stats_json(self, executor=None, **kw) -> str:
+        return json.dumps(self.stats(executor=executor), **kw)

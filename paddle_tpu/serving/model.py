@@ -1,0 +1,99 @@
+"""ServableModel: an immutable frozen program + pinned device weights.
+
+Wraps `io.load_inference_model` output into the unit a serving engine
+schedules: the pruned inference Program, its feed/fetch metadata, a
+PRIVATE scope holding the persistable weights as device arrays (so a
+co-resident training loop mutating the global scope can never corrupt a
+live server), and a dedicated Executor whose compile cache holds one
+jitted executable per (bucket shape, fetch signature).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from .. import io
+from ..core.scope import Scope
+from ..executor import Executor, scope_guard
+
+__all__ = ["ServableModel"]
+
+
+class ServableModel:
+    def __init__(self, program, feed_names: List[str], fetch_vars,
+                 scope: Scope, feed_specs: Dict[str, Dict],
+                 fetch_specs: Dict[str, Dict]):
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_vars = list(fetch_vars)
+        self.fetch_names = [v if isinstance(v, str) else v.name
+                            for v in fetch_vars]
+        self.scope = scope
+        self.feed_specs = dict(feed_specs)
+        self.fetch_specs = dict(fetch_specs)
+        self.executor = Executor()
+        self._engine = None  # set by ServingEngine.start()
+        # Executor internals (compile cache + counters, scope step var,
+        # deferred flags) are not thread-safe; serialize runs so
+        # num_workers > 1 engines stay correct (workers still overlap
+        # host-side batch assembly with the device run).
+        self._run_lock = threading.Lock()
+        self._check_frozen()
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def load(cls, dirname: str, model_filename: Optional[str] = None,
+             params_filename: Optional[str] = None) -> "ServableModel":
+        """Load a `save_inference_model` directory into a private scope."""
+        scope = Scope()
+        exe = Executor()
+        with scope_guard(scope):
+            prog, feed_names, fetch_vars, meta = io.load_inference_model(
+                dirname, exe, model_filename=model_filename,
+                params_filename=params_filename, return_meta=True)
+        return cls(prog, feed_names, fetch_vars, scope,
+                   meta["feed_specs"], meta["fetch_specs"])
+
+    def _check_frozen(self):
+        """A servable program must not write persistable state: an
+        optimizer op left in the graph would silently train on traffic.
+        Checked across ALL blocks — a write buried in a while/cond body
+        mutates the pinned weights just the same. (The step counter is
+        the executor's, not the program's.)"""
+        offenders = []
+        for block in self.program.desc.blocks:
+            for op in block.ops:
+                for name in op.output_names():
+                    v = block.find_var_recursive(name)
+                    if v is not None and v.persistable:
+                        offenders.append((op.type, name))
+        if offenders:
+            raise ValueError(
+                "program is not frozen for inference — ops write "
+                f"persistable vars: {offenders}; re-export with "
+                "save_inference_model (which prunes the training graph)")
+
+    # ------------------------------------------------------------------
+    def run_direct(self, feed: Dict[str, Any]):
+        """One synchronous Executor.run against the pinned weights,
+        bypassing the batcher. The engine's batch path and warmup both
+        land here, so a request served through the engine is bit-identical
+        to a direct run with the same padded batch."""
+        with self._run_lock:
+            return self.executor.run(self.program, feed=feed,
+                                     fetch_list=self.fetch_names,
+                                     scope=self.scope)
+
+    def predict(self, feed: Dict[str, Any],
+                timeout: Optional[float] = None):
+        """Predict one request: through the attached engine (dynamic
+        batching) when one is serving this model, else a direct run."""
+        if self._engine is not None:
+            return self._engine.predict(feed, timeout=timeout)
+        return self.run_direct(feed)
+
+    def serve(self, config=None, metrics=None, num_workers: int = 1):
+        """Create (but do not start) a ServingEngine bound to this model."""
+        from .engine import ServingEngine
+        return ServingEngine(self, config=config, metrics=metrics,
+                             num_workers=num_workers)

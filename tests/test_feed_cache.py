@@ -1,0 +1,68 @@
+"""Unit tests for the executor's bounded device-side feed cache: LRU
+eviction (a just-reused entry must not be the victim) and the size
+bound."""
+import numpy as np
+import pytest
+
+from paddle_tpu.core import executor as ex
+
+
+@pytest.fixture(autouse=True)
+def small_cache(monkeypatch):
+    monkeypatch.setattr(ex, "_FEED_CACHE_MAX", 2)
+    ex._feed_cache.clear()
+    yield
+    ex._feed_cache.clear()
+
+
+def _frozen(fill):
+    arr = np.full((4,), fill, np.float32)
+    arr.flags.writeable = False
+    return arr
+
+
+def test_eviction_is_lru_not_insertion_order():
+    a, b, c = _frozen(1.0), _frozen(2.0), _frozen(3.0)
+    dev_a = ex._cached_device_put(a)   # cache: [a]
+    ex._cached_device_put(b)           # cache: [a, b]
+    # touch a: under LRU it becomes most-recent; under insertion-order
+    # eviction it would (wrongly) still be the next victim
+    assert ex._cached_device_put(a) is dev_a
+    ex._cached_device_put(c)           # bound 2: evicts b, NOT a
+    assert ex._cached_device_put(a) is dev_a           # still cached
+    assert id(b) not in ex._feed_cache                 # b was the victim
+    assert id(a) in ex._feed_cache and id(c) in ex._feed_cache
+
+
+def test_cache_respects_bound():
+    arrs = [_frozen(float(i)) for i in range(5)]
+    for arr in arrs:
+        ex._cached_device_put(arr)
+    assert len(ex._feed_cache) <= 2
+    # most recent survive
+    assert id(arrs[-1]) in ex._feed_cache
+    assert id(arrs[-2]) in ex._feed_cache
+
+
+def test_hit_returns_same_device_array():
+    a = _frozen(7.0)
+    dev1 = ex._cached_device_put(a)
+    dev2 = ex._cached_device_put(a)
+    assert dev1 is dev2
+
+
+def test_writeable_arrays_bypass_cache():
+    arr = np.ones((4,), np.float32)  # writeable: must not be cached
+    ex._maybe_cached(arr)
+    assert id(arr) not in ex._feed_cache
+
+
+def test_dead_array_entry_is_collected():
+    import gc
+    a = _frozen(1.0)
+    key = id(a)
+    ex._cached_device_put(a)
+    assert key in ex._feed_cache
+    del a
+    gc.collect()
+    assert key not in ex._feed_cache

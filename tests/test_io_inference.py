@@ -1,0 +1,101 @@
+"""save_inference_model / load_inference_model round trip with a pruned
+multi-op training program (guards io._prune / _prune_py), plus the
+feed/fetch metadata surface added for serving."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _build_trained_model(steps=3):
+    main = pt.Program()
+    startup = pt.Program()
+    main.random_seed = startup.random_seed = 7
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [6], dtype="float32")
+        label = layers.data("label", [1], dtype="float32")
+        h = layers.fc(x, size=8, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, label))
+        pt.optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    for _ in range(steps):
+        exe.run(main, feed={
+            "x": rng.rand(4, 6).astype(np.float32),
+            "label": rng.rand(4, 1).astype(np.float32),
+        }, fetch_list=[loss])
+    return main, pred, exe
+
+
+def test_inference_round_trip_matches_unpruned(tmp_path):
+    main, pred, exe = _build_trained_model()
+    xv = np.random.RandomState(1).rand(5, 6).astype(np.float32)
+    # freeze FIRST (snapshot of current weights) ...
+    dirname = str(tmp_path / "inf")
+    pt.io.save_inference_model(dirname, ["x"], [pred], exe, main)
+    # ... then ground truth from the FULL (unpruned) training program:
+    # within one step, pred is computed from the pre-update weights —
+    # exactly the ones just saved (the sgd write lands after the fetch)
+    (want,) = exe.run(main, feed={
+        "x": xv, "label": np.zeros((5, 1), np.float32)},
+        fetch_list=[pred])
+
+    # load into a fresh scope so values can only come from the checkpoint
+    scope = pt.Scope()
+    from paddle_tpu.executor import scope_guard
+    with scope_guard(scope):
+        exe2 = pt.Executor()
+        prog, feed_names, fetch_vars = pt.io.load_inference_model(
+            dirname, exe2)
+        assert feed_names == ["x"]
+        (got,) = exe2.run(prog, feed={"x": xv}, fetch_list=fetch_vars)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_prune_drops_training_ops(tmp_path):
+    main, pred, exe = _build_trained_model(steps=1)
+    dirname = str(tmp_path / "inf")
+    pt.io.save_inference_model(dirname, ["x"], [pred], exe, main)
+    scope = pt.Scope()
+    from paddle_tpu.executor import scope_guard
+    with scope_guard(scope):
+        prog, _, _ = pt.io.load_inference_model(dirname, pt.Executor())
+    pruned_types = [op.type for op in prog.desc.global_block.ops]
+    train_types = [op.type for op in main.desc.global_block.ops]
+    assert len(pruned_types) < len(train_types)
+    assert "sgd" not in pruned_types
+    assert not any("grad" in t for t in pruned_types)
+    # label is train-only: the pruned slice must not require it
+    assert all("label" not in op.input_names()
+               for op in prog.desc.global_block.ops)
+
+
+def test_load_inference_model_returns_bucketing_meta(tmp_path):
+    main, pred, exe = _build_trained_model(steps=1)
+    dirname = str(tmp_path / "inf")
+    pt.io.save_inference_model(dirname, ["x"], [pred], exe, main)
+    scope = pt.Scope()
+    from paddle_tpu.executor import scope_guard
+    with scope_guard(scope):
+        prog, feed_names, fetch_vars, meta = pt.io.load_inference_model(
+            dirname, pt.Executor(), return_meta=True)
+    spec = meta["feed_specs"]["x"]
+    assert spec["shape"] == [-1, 6]
+    assert spec["dtype"] == "float32"
+    assert spec["lod_level"] == 0
+    assert list(meta["fetch_specs"]) == [v.name for v in fetch_vars]
+
+
+def test_inference_model_specs_helper():
+    main = pt.Program()
+    with pt.program_guard(main):
+        x = layers.data("x", [3, 4], dtype="int64")
+        y = layers.fc(x.astype("float32"), size=2, num_flatten_dims=2)
+    feed_specs, fetch_specs = pt.io.inference_model_specs(
+        main, ["x"], [y.name])
+    assert feed_specs["x"]["shape"] == [-1, 3, 4]
+    assert feed_specs["x"]["dtype"] == "int64"
+    assert y.name in fetch_specs

@@ -1,0 +1,274 @@
+"""Acceptance tests for paddle_tpu.serving (ISSUE 1): engine results
+bit-identical to direct Executor.run, one compilation per bucket, and
+graceful drain on stop()."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, serving
+
+
+def _freeze_mlp(tmp_path, in_dim=8, hidden=16, out_dim=4, seed=0):
+    """Build+init a small MLP, freeze it with save_inference_model."""
+    main = pt.Program()
+    startup = pt.Program()
+    main.random_seed = startup.random_seed = seed
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [in_dim], dtype="float32")
+        h = layers.fc(x, size=hidden, act="relu")
+        pred = layers.fc(h, size=out_dim, act="softmax")
+    exe = pt.Executor()
+    exe.run(startup)
+    dirname = str(tmp_path / "model")
+    pt.io.save_inference_model(dirname, ["x"], [pred], exe, main)
+    return dirname
+
+
+def test_engine_bit_identical_to_direct_run(tmp_path):
+    dirname = _freeze_mlp(tmp_path)
+    model = serving.load(dirname)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(4, 8).astype(np.float32)}
+    (direct,) = model.run_direct(feed)
+
+    engine = model.serve(serving.BatchingConfig(
+        max_batch_size=4, batch_buckets=[4], max_latency_ms=1.0))
+    engine.start(warmup=False)
+    try:
+        # 4 rows fill the [4] bucket exactly: no padding, the engine runs
+        # the very same executable on the very same input
+        (served,) = engine.predict(feed, timeout=30)
+        np.testing.assert_array_equal(served, direct)
+        # model.predict routes through the attached engine
+        (served2,) = model.predict(feed, timeout=30)
+        np.testing.assert_array_equal(served2, direct)
+    finally:
+        engine.stop()
+
+
+def test_one_compilation_per_bucket(tmp_path):
+    dirname = _freeze_mlp(tmp_path)
+    model = serving.load(dirname)
+    engine = model.serve(serving.BatchingConfig(
+        max_batch_size=4, batch_buckets=[4], max_latency_ms=1.0))
+    engine.start(warmup=False)
+    try:
+        rng = np.random.RandomState(1)
+        (o1,) = engine.predict({"x": rng.rand(1, 8).astype(np.float32)},
+                               timeout=60)
+        (o2,) = engine.predict({"x": rng.rand(2, 8).astype(np.float32)},
+                               timeout=60)
+        assert o1.shape == (1, 4) and o2.shape == (2, 4)
+    finally:
+        engine.stop()
+    # both requests padded into the same [4] bucket: exactly one
+    # compilation, the second request hit the executable cache
+    cc = engine.stats()["compile_cache"]
+    assert cc["misses"] == 1, cc
+    assert cc["hits"] == 1, cc
+
+
+def test_padded_rows_do_not_change_real_rows(tmp_path):
+    dirname = _freeze_mlp(tmp_path)
+    model = serving.load(dirname)
+    rng = np.random.RandomState(2)
+    feed = {"x": rng.rand(3, 8).astype(np.float32)}
+    (direct,) = model.run_direct(feed)  # compiles the unpadded (3, 8) sig
+    engine = model.serve(serving.BatchingConfig(
+        max_batch_size=8, batch_buckets=[8], max_latency_ms=1.0))
+    engine.start(warmup=False)
+    try:
+        (served,) = engine.predict(feed, timeout=30)  # padded 3 -> 8
+    finally:
+        engine.stop()
+    assert served.shape == direct.shape
+    np.testing.assert_allclose(served, direct, rtol=1e-6, atol=1e-7)
+
+
+def test_stop_drains_in_flight_requests(tmp_path):
+    dirname = _freeze_mlp(tmp_path)
+    model = serving.load(dirname)
+    # deadline far away + buckets larger than the queued rows: nothing
+    # flushes until stop() drains
+    engine = model.serve(serving.BatchingConfig(
+        max_batch_size=8, batch_buckets=[8], max_latency_ms=60_000.0))
+    engine.start(warmup=False)
+    rng = np.random.RandomState(3)
+    feeds = [{"x": rng.rand(1, 8).astype(np.float32)} for _ in range(3)]
+    futures = [engine.submit(f) for f in feeds]
+    assert not any(f.done() for f in futures)
+    engine.stop(drain=True, timeout=120)
+    for fut, feed in zip(futures, feeds):
+        (out,) = fut.result(timeout=0)  # already completed by drain
+        (direct,) = model.run_direct(feed)
+        np.testing.assert_allclose(out, direct, rtol=1e-6, atol=1e-7)
+    stats = engine.stats()
+    assert stats["requests"] == 3
+    assert stats["errors"] == 0 and stats["timeouts"] == 0
+    with pytest.raises(serving.ServingStopped):
+        engine.submit(feeds[0])
+
+
+def test_warmup_precompiles_buckets(tmp_path):
+    dirname = _freeze_mlp(tmp_path)
+    model = serving.load(dirname)
+    engine = model.serve(serving.BatchingConfig(
+        max_batch_size=4, batch_buckets=[2, 4], max_latency_ms=1.0))
+    engine.start(warmup=True)
+    try:
+        assert engine.stats()["warmup_compiles"] == 2
+        misses_after_warmup = model.executor.cache_stats["misses"]
+        (out,) = engine.predict(
+            {"x": np.zeros((2, 8), np.float32)}, timeout=30)
+        assert out.shape == (2, 4)
+        # traffic inside a warmed bucket compiles nothing
+        assert model.executor.cache_stats["misses"] == misses_after_warmup
+    finally:
+        engine.stop()
+
+
+def test_stats_snapshot_is_json_able(tmp_path):
+    import json
+    dirname = _freeze_mlp(tmp_path)
+    model = serving.load(dirname)
+    engine = model.serve(serving.BatchingConfig(
+        max_batch_size=2, batch_buckets=[2], max_latency_ms=1.0))
+    engine.start(warmup=False)
+    try:
+        engine.predict({"x": np.ones((1, 8), np.float32)}, timeout=30)
+    finally:
+        engine.stop()
+    stats = json.loads(json.dumps(engine.stats()))
+    assert stats["batches"] >= 1
+    assert stats["latency_s"]["count"] >= 1
+    assert 0.0 < stats["batch_fill_ratio"]["p50"] <= 1.0
+    assert stats["compile_cache"]["misses"] >= 1
+
+
+def test_batch_level_fetch_delivered_whole(tmp_path):
+    """A fetch whose static leading dim happens to EQUAL the bucket size
+    (here: per-class column sum of shape (4,) with batch bucket 4) must
+    still be delivered whole, not sliced per request."""
+    main = pt.Program()
+    startup = pt.Program()
+    main.random_seed = startup.random_seed = 0
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8], dtype="float32")
+        pred = layers.fc(x, size=4, act="softmax")
+        colsum = layers.reduce_sum(pred, dim=0)  # static shape (4,)
+    exe = pt.Executor()
+    exe.run(startup)
+    dirname = str(tmp_path / "model")
+    pt.io.save_inference_model(dirname, ["x"], [pred, colsum], exe, main)
+
+    model = serving.load(dirname)
+    engine = model.serve(serving.BatchingConfig(
+        max_batch_size=4, batch_buckets=[4], max_latency_ms=1.0))
+    engine.start(warmup=False)
+    try:
+        feed = {"x": np.random.RandomState(5).rand(1, 8).astype(np.float32)}
+        pred_out, colsum_out = engine.predict(feed, timeout=30)
+    finally:
+        engine.stop()
+    assert pred_out.shape == (1, 4)      # per-row: sliced to the request
+    assert colsum_out.shape == (4,)      # batch-level: whole vector
+
+
+def test_two_workers_serve_correctly(tmp_path):
+    dirname = _freeze_mlp(tmp_path)
+    model = serving.load(dirname)
+    engine = model.serve(serving.BatchingConfig(
+        max_batch_size=4, batch_buckets=[4], max_latency_ms=2.0),
+        num_workers=2)
+    engine.start(warmup=True)
+    rng = np.random.RandomState(6)
+    try:
+        feeds = [{"x": rng.rand(1, 8).astype(np.float32)}
+                 for _ in range(12)]
+        futures = [engine.submit(f) for f in feeds]
+        for fut, feed in zip(futures, feeds):
+            (out,) = fut.result(timeout=60)
+            (direct,) = model.run_direct(feed)
+            np.testing.assert_allclose(out, direct, rtol=1e-6, atol=1e-7)
+    finally:
+        engine.stop(drain=True, timeout=120)
+    assert engine.stats()["errors"] == 0
+
+
+def test_model_predict_falls_back_outside_engine_lifetime(tmp_path):
+    dirname = _freeze_mlp(tmp_path)
+    model = serving.load(dirname)
+    feed = {"x": np.ones((2, 8), np.float32)}
+    engine = model.serve(serving.BatchingConfig(
+        max_batch_size=2, batch_buckets=[2], max_latency_ms=1.0))
+    # between serve() and start(): predict must run direct, not hang
+    (before,) = model.predict(feed)
+    engine.start(warmup=False)
+    try:
+        (during,) = model.predict(feed, timeout=30)
+    finally:
+        engine.stop()
+    # after stop(): falls back to direct again instead of ServingStopped
+    (after,) = model.predict(feed)
+    np.testing.assert_array_equal(before, during)
+    np.testing.assert_array_equal(before, after)
+
+
+def test_unfrozen_program_rejected(tmp_path):
+    main = pt.Program()
+    startup = pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        label = layers.data("label", [1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, label))
+        pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    from paddle_tpu.serving import ServableModel
+    from paddle_tpu.io import inference_model_specs
+    feed_specs, fetch_specs = inference_model_specs(
+        main, ["x", "label"], [loss.name])
+    with pytest.raises(ValueError, match="not frozen"):
+        ServableModel(main, ["x", "label"], [loss], pt.global_scope(),
+                      feed_specs, fetch_specs)
+
+
+@pytest.mark.slow
+def test_sustained_concurrent_load(tmp_path):
+    """Many client threads against one engine: every request answered,
+    batches actually formed (fill ratio observed), no drops on stop."""
+    import threading
+
+    dirname = _freeze_mlp(tmp_path)
+    model = serving.load(dirname)
+    engine = model.serve(serving.BatchingConfig(
+        max_batch_size=16, max_latency_ms=5.0,
+        queue_capacity_rows=4096))
+    engine.start(warmup=True)
+    rng = np.random.RandomState(4)
+    n_clients, n_requests = 4, 25
+    errors = []
+
+    def client(cid):
+        for i in range(n_requests):
+            feed = {"x": rng.rand(1 + (i % 3), 8).astype(np.float32)}
+            try:
+                (out,) = engine.predict(feed, timeout=60)
+                assert out.shape == (feed["x"].shape[0], 4)
+            except Exception as e:  # pragma: no cover
+                errors.append((cid, i, e))
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    engine.stop(drain=True, timeout=120)
+    assert not errors
+    stats = engine.stats()
+    assert stats["requests"] == n_clients * n_requests
+    assert stats["errors"] == 0

@@ -1,0 +1,166 @@
+"""Unit tests for the dynamic batcher: flush conditions, bucket padding,
+backpressure, and queue-side request expiry (no engine/executor)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import (BatchingConfig, DynamicBatcher,
+                                QueueFullError, ServingStopped)
+from paddle_tpu.serving.metrics import ServingMetrics
+
+SPECS = {"x": {"shape": [-1, 3], "dtype": "float32", "lod_level": 0}}
+
+
+def _feed(rows, fill=1.0):
+    return {"x": np.full((rows, 3), fill, np.float32)}
+
+
+def test_max_batch_flush_is_immediate():
+    b = DynamicBatcher(SPECS, BatchingConfig(
+        max_batch_size=4, batch_buckets=[4], max_latency_ms=10_000.0))
+    for i in range(4):
+        b.submit(_feed(1, float(i)))
+    t0 = time.monotonic()
+    batch = b.next_batch(timeout=5)
+    # full bucket: must not wait for the 10s latency deadline
+    assert time.monotonic() - t0 < 1.0
+    assert batch is not None and batch.rows == 4
+    assert batch.bucket_rows == 4 and batch.fill_ratio == 1.0
+
+
+def test_deadline_flush_on_partial_batch():
+    b = DynamicBatcher(SPECS, BatchingConfig(
+        max_batch_size=8, batch_buckets=[8], max_latency_ms=50.0))
+    b.submit(_feed(2))
+    t0 = time.monotonic()
+    batch = b.next_batch(timeout=5)
+    waited = time.monotonic() - t0
+    assert batch is not None and batch.rows == 2
+    assert batch.bucket_rows == 8
+    assert waited >= 0.04  # sat out (most of) the deadline
+    assert abs(batch.fill_ratio - 2 / 8) < 1e-9
+
+
+def test_bucket_padding_layout_and_slices():
+    b = DynamicBatcher(SPECS, BatchingConfig(
+        max_batch_size=8, batch_buckets=[4, 8], max_latency_ms=1.0))
+    b.submit(_feed(1, 1.0))
+    b.submit(_feed(2, 2.0))
+    batch = b.next_batch(timeout=5)
+    assert batch.rows == 3 and batch.bucket_rows == 4
+    assert batch.slices == [(0, 1), (1, 3)]
+    x = batch.feed["x"]
+    assert x.shape == (4, 3)
+    np.testing.assert_array_equal(x[0], np.full(3, 1.0, np.float32))
+    np.testing.assert_array_equal(x[1:3], np.full((2, 3), 2.0, np.float32))
+    np.testing.assert_array_equal(x[3], np.zeros(3, np.float32))  # padding
+
+
+def test_seq_dim_bucketing_merges_mixed_lengths():
+    specs = {"t": {"shape": [-1, -1], "dtype": "int64", "lod_level": 0}}
+    b = DynamicBatcher(specs, BatchingConfig(
+        max_batch_size=4, batch_buckets=[4], seq_buckets=[8, 16],
+        max_latency_ms=1.0))
+    b.submit({"t": np.arange(5, dtype=np.int64)[None]})   # len 5
+    b.submit({"t": np.arange(7, dtype=np.int64)[None]})   # len 7
+    batch = b.next_batch(timeout=5)
+    t = batch.feed["t"]
+    assert t.shape == (4, 8)  # batch bucket 4, seq bucket 8
+    np.testing.assert_array_equal(t[0, :5], np.arange(5))
+    np.testing.assert_array_equal(t[0, 5:], np.zeros(3, np.int64))
+    np.testing.assert_array_equal(t[1, :7], np.arange(7))
+
+
+def test_backpressure_rejects_when_queue_full():
+    m = ServingMetrics()
+    b = DynamicBatcher(SPECS, BatchingConfig(
+        max_batch_size=2, batch_buckets=[2], max_latency_ms=10_000.0,
+        queue_capacity_rows=2), metrics=m)
+    b.submit(_feed(1))
+    b.submit(_feed(1))
+    with pytest.raises(QueueFullError):
+        b.submit(_feed(1))
+    assert m.rejected.value == 1
+    assert m.requests.value == 2
+    # draining the queue frees capacity again
+    assert b.next_batch(timeout=5) is not None
+    b.submit(_feed(1))
+
+
+def test_request_deadline_pulls_flush_earlier_than_latency_deadline():
+    # request_timeout < max_latency on an idle server: the request must
+    # be FLUSHED before it expires, not expired at the latency deadline
+    b = DynamicBatcher(SPECS, BatchingConfig(
+        max_batch_size=8, batch_buckets=[8], max_latency_ms=10_000.0,
+        request_timeout_ms=80.0))
+    fut = b.submit(_feed(1))
+    t0 = time.monotonic()
+    batch = b.next_batch(timeout=5)
+    assert batch is not None and batch.rows == 1
+    assert time.monotonic() - t0 < 1.0  # well before the 10s deadline
+    assert not fut.done()  # delivered by the engine, not failed here
+
+
+def test_request_expires_in_queue():
+    b = DynamicBatcher(SPECS, BatchingConfig(
+        max_batch_size=8, batch_buckets=[8], max_latency_ms=10_000.0,
+        request_timeout_ms=20.0))
+    fut = b.submit(_feed(1))
+    time.sleep(0.05)
+    assert b.next_batch(timeout=0.05) is None  # expired, nothing to flush
+    with pytest.raises(TimeoutError):
+        fut.result(timeout=0)
+
+
+def test_close_without_drain_fails_pending():
+    b = DynamicBatcher(SPECS, BatchingConfig(
+        max_batch_size=8, batch_buckets=[8], max_latency_ms=10_000.0))
+    fut = b.submit(_feed(1))
+    b.close(drain=False)
+    with pytest.raises(ServingStopped):
+        fut.result(timeout=1)
+    assert b.next_batch(timeout=0.1) is None
+    with pytest.raises(ServingStopped):
+        b.submit(_feed(1))
+
+
+def test_submit_wakes_blocked_consumer():
+    b = DynamicBatcher(SPECS, BatchingConfig(
+        max_batch_size=2, batch_buckets=[2], max_latency_ms=5_000.0))
+    got = []
+
+    def consume():
+        got.append(b.next_batch(timeout=10))
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.05)
+    b.submit(_feed(2))  # fills the bucket: immediate flush
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert got and got[0] is not None and got[0].rows == 2
+
+
+def test_feed_validation():
+    b = DynamicBatcher(SPECS, BatchingConfig(max_batch_size=4))
+    with pytest.raises(ValueError, match="mismatch"):
+        b.submit({"y": np.zeros((1, 3), np.float32)})
+    with pytest.raises(ValueError, match="dim 1"):
+        b.submit({"x": np.zeros((1, 5), np.float32)})
+    with pytest.raises(ValueError, match="exceed max_batch_size"):
+        b.submit(_feed(5))
+    # a single sample without the batch axis is auto-expanded
+    fut = b.submit({"x": np.zeros(3, np.float32)})
+    batch = b.next_batch(timeout=5)
+    assert batch.rows == 1 and fut is batch.requests[0].future
+
+
+def test_ragged_and_static_feeds_rejected_at_construction():
+    with pytest.raises(ValueError, match="LoD"):
+        DynamicBatcher({"s": {"shape": [-1, 4], "dtype": "float32",
+                              "lod_level": 1}}, BatchingConfig())
+    with pytest.raises(ValueError, match="batch dim"):
+        DynamicBatcher({"s": {"shape": [4, 4], "dtype": "float32",
+                              "lod_level": 0}}, BatchingConfig())
