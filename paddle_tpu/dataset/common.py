@@ -12,11 +12,20 @@ from __future__ import annotations
 
 import hashlib
 import os
+from typing import Callable, Optional
 
 import numpy as np
 
+from ..resilience import faults
+from ..resilience.retry import RetryPolicy
+
 DATA_HOME = os.path.expanduser(
     os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+#: downloads are the classic transient-failure I/O: retry a few times
+#: with jittered exponential backoff before giving up
+DOWNLOAD_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.1,
+                             max_delay_s=5.0)
 
 
 def cache_path(*parts) -> str:
@@ -29,6 +38,66 @@ def md5file(fname: str) -> str:
         for chunk in iter(lambda: f.read(1 << 20), b""):
             h.update(chunk)
     return h.hexdigest()
+
+
+def download(url: str, module: str, md5sum: Optional[str] = None,
+             save_name: Optional[str] = None,
+             retry: Optional[RetryPolicy] = None,
+             fetch: Optional[Callable[[str, str], None]] = None) -> str:
+    """Fetch `url` into DATA_HOME/<module>/ and return the cached path
+    (reference: python/paddle/dataset/common.py download, rebuilt on the
+    unified retry layer).
+
+    Crash/corruption safety: the transfer writes to a `.part` file that
+    is md5-verified and then atomically renamed into place, so the cache
+    never contains a partial archive; a failed or interrupted attempt
+    deletes its `.part` before the next retry, and a cached file that no
+    longer matches `md5sum` is discarded and re-fetched rather than
+    served corrupt.
+
+    fetch(url, path): injectable transfer fn (tests, mirrors); defaults
+    to urllib. retry: RetryPolicy, default `DOWNLOAD_RETRY`.
+    """
+    dirname = cache_path(module)
+    os.makedirs(dirname, exist_ok=True)
+    fname = os.path.join(dirname, save_name or url.split("/")[-1])
+    if os.path.exists(fname):
+        if md5sum is None or md5file(fname) == md5sum:
+            return fname
+        try:
+            os.remove(fname)  # stale/corrupt cache entry
+        except FileNotFoundError:
+            pass  # a concurrent downloader already removed/replaced it
+
+    def _fetch_once() -> str:
+        # unique temp per attempt: concurrent downloaders (multiprocess
+        # reader workers on a cold cache) must not interleave into one
+        # shared .part file or delete each other's in-progress transfer
+        import tempfile
+        fd, part = tempfile.mkstemp(
+            dir=dirname, prefix=os.path.basename(fname) + ".",
+            suffix=".part")
+        os.close(fd)
+        try:
+            faults.fire("dataset.download")
+            if fetch is not None:
+                fetch(url, part)
+            else:
+                import urllib.request
+                urllib.request.urlretrieve(url, part)
+            if md5sum is not None and md5file(part) != md5sum:
+                raise IOError(
+                    f"downloaded {url} fails md5 verification "
+                    f"(expected {md5sum})")
+            os.replace(part, fname)
+        except BaseException:
+            if os.path.exists(part):
+                os.remove(part)
+            raise
+        return fname
+
+    policy = retry if retry is not None else DOWNLOAD_RETRY
+    return policy.call(_fetch_once, name="dataset.download")
 
 
 def rng_for(name: str, split: str) -> np.random.RandomState:

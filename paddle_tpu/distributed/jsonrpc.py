@@ -1,0 +1,121 @@
+"""Shared reconnecting JSON-lines RPC client.
+
+Both host-side control-plane services (master task queue, async pserver)
+speak the same newline-delimited-JSON-over-TCP idiom; this is the one
+client transport under both, so the reconnect/retry path exists exactly
+once. Transport failures (dropped socket, refused connect, torn reply
+line) close the connection and retry under the injected
+resilience.RetryPolicy — the next attempt reconnects; non-transport
+(application) errors propagate without retry.
+
+Subclasses customize: `_handle_resp` (e.g. raise on an {"error": ...}
+reply), `_retry_name` (the retry-counter/profiler label), and pass a
+per-call `fault_point` to arm chaos-test injection on specific methods.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Optional
+
+from ..resilience import faults
+from ..resilience.retry import RetryError, RetryPolicy
+
+
+class JSONLinesClient:
+    """Blocking JSON-lines client with reconnect-under-retry-policy.
+
+    timeout:           socket op timeout for replies (None = block
+                       forever — required for fan-in barrier pushes).
+    connect_timeout_s: TCP connect timeout per attempt.
+    eager_connect:     connect in the constructor (fail fast on a bad
+                       endpoint) instead of on first call.
+
+    `retries` counts reconnect attempts actually taken — the observable
+    signal that the client rode through connection drops.
+    """
+
+    def __init__(self, endpoint: str, retry: RetryPolicy,
+                 timeout: Optional[float] = None,
+                 connect_timeout_s: float = 30.0,
+                 eager_connect: bool = False):
+        self.endpoint = endpoint
+        self.retry = retry
+        self.retries = 0
+        self._timeout = timeout
+        self._connect_timeout_s = connect_timeout_s
+        self._sock = None
+        self._file = None
+        self._lock = threading.Lock()
+        if eager_connect:
+            self._connect()
+
+    # -- transport -----------------------------------------------------
+    def _connect(self):
+        host, port = self.endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection(
+            (host, int(port)), timeout=self._connect_timeout_s)
+        self._sock.settimeout(self._timeout)
+        self._file = self._sock.makefile("rwb")
+
+    def _close(self):
+        try:
+            if self._sock:
+                self._sock.close()
+        except OSError:
+            pass
+        self._sock = self._file = None
+
+    def close(self):
+        self._close()
+
+    # -- request path --------------------------------------------------
+    def _handle_resp(self, resp: dict) -> dict:
+        return resp
+
+    def _retry_name(self, req: dict) -> str:
+        return "jsonrpc"
+
+    def _attempt(self, req: dict, fault_point: Optional[str]) -> dict:
+        if fault_point:
+            faults.fire(fault_point)
+        if self._file is None:
+            self._connect()
+        self._file.write((json.dumps(req) + "\n").encode())
+        self._file.flush()
+        line = self._file.readline()
+        if not line:
+            raise ConnectionError("server closed connection")
+        try:
+            resp = json.loads(line)
+        except json.JSONDecodeError as e:
+            # a torn reply line (server died mid-write) is a dropped
+            # connection, classified HERE so every retry policy sees a
+            # transport error without having to know the wire format
+            raise ConnectionError(
+                f"torn reply from {self.endpoint}: {e}") from e
+        return self._handle_resp(resp)
+
+    def _on_retry(self, attempt: int, exc: BaseException):
+        self.retries += 1
+        self._close()  # next attempt reconnects
+
+    def _call(self, req: dict,
+              fault_point: Optional[str] = None) -> dict:
+        with self._lock:
+            try:
+                return self.retry.call(self._attempt, req, fault_point,
+                                       name=self._retry_name(req),
+                                       on_retry=self._on_retry)
+            except (OSError, RetryError):
+                # transport-level (socket errors, torn replies — both
+                # surface as OSError/ConnectionError here — or a retry
+                # deadline over one of those): stream state unknown,
+                # drop the connection
+                self._close()
+                raise
+            # anything else is an application error raised by
+            # _handle_resp AFTER a complete reply: the stream is in
+            # sync, keep the healthy connection (contract: subclasses
+            # raise app errors as non-OSError types)

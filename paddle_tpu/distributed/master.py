@@ -14,13 +14,14 @@ import base64
 import ctypes
 import json
 import os
-import socket
 import socketserver
 import threading
 import time
 from typing import Callable, Iterable, List, Optional, Sequence
 
 from ..native import lib
+from ..resilience.retry import RetryPolicy
+from .jsonrpc import JSONLinesClient
 
 
 class Master:
@@ -216,49 +217,36 @@ class MasterServer:
         self._server.server_close()
 
 
-class MasterClient:
+class MasterClient(JSONLinesClient):
     """Client with reconnect + the Go client's task-loop semantics
-    (reference: go/master/client.go + python/paddle/v2/master/client.py:29)."""
+    (reference: go/master/client.go + python/paddle/v2/master/client.py:29).
+
+    Reconnects ride the shared resilience.RetryPolicy (exponential
+    backoff + jitter, via distributed/jsonrpc.py) instead of the old
+    fixed-interval sleep; `retry_s` / `max_retries` are kept as the
+    legacy spelling and seed the default policy: retry_s becomes the
+    BASE delay and the overall DEADLINE is retry_s * max_retries plus
+    two connect timeouts — the legacy ~10s budget for fast-failing
+    (refused) masters, with headroom so a single HUNG connect cannot
+    exhaust the budget in one attempt. Exceeding the deadline raises
+    resilience.RetryError with the transport error as __cause__."""
 
     def __init__(self, endpoint: str, retry_s: float = 0.2,
-                 max_retries: int = 50):
-        self.endpoint = endpoint
-        self.retry_s = retry_s
-        self.max_retries = max_retries
-        self._sock = None
-        self._file = None
-        self._lock = threading.Lock()
+                 max_retries: int = 50,
+                 retry: Optional[RetryPolicy] = None,
+                 connect_timeout_s: float = 10.0):
+        policy = retry or RetryPolicy(
+            max_attempts=max_retries, base_delay_s=retry_s,
+            max_delay_s=max(retry_s, 2.0),
+            deadline_s=retry_s * max_retries + 2 * connect_timeout_s)
+        super().__init__(endpoint, policy, timeout=30.0,
+                         connect_timeout_s=connect_timeout_s)
 
-    def _connect(self):
-        host, port = self.endpoint.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)), timeout=30)
-        self._file = self._sock.makefile("rwb")
+    def _retry_name(self, req: dict) -> str:
+        return "master.rpc"
 
-    def _call(self, req: dict) -> dict:
-        with self._lock:
-            for attempt in range(self.max_retries):
-                try:
-                    if self._file is None:
-                        self._connect()
-                    self._file.write((json.dumps(req) + "\n").encode())
-                    self._file.flush()
-                    line = self._file.readline()
-                    if not line:
-                        raise ConnectionError("server closed")
-                    return json.loads(line)
-                except (OSError, ConnectionError, json.JSONDecodeError):
-                    self._close()
-                    if attempt == self.max_retries - 1:
-                        raise
-                    time.sleep(self.retry_s)
-
-    def _close(self):
-        try:
-            if self._sock:
-                self._sock.close()
-        except OSError:
-            pass
-        self._sock = self._file = None
+    def _call(self, req: dict, fault_point: str = "master.rpc") -> dict:
+        return super()._call(req, fault_point=fault_point)
 
     def get_task(self):
         r = self._call({"method": "get_task"})

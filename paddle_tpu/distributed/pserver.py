@@ -22,12 +22,14 @@ from __future__ import annotations
 
 import base64
 import json
-import socket
 import socketserver
 import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
+
+from ..resilience.retry import RetryPolicy
+from .jsonrpc import JSONLinesClient
 
 __all__ = ["AsyncParameterServer", "PServerServer", "PServerClient"]
 
@@ -410,33 +412,38 @@ class PServerServer:
         self._server.server_close()
 
 
-class PServerClient:
-    """Blocking JSON-lines client (one socket per client; thread-safe)."""
+class PServerClient(JSONLinesClient):
+    """Blocking JSON-lines client (one socket per client; thread-safe).
+
+    Transport failures reconnect under a resilience.RetryPolicy (the
+    shared distributed/jsonrpc.py path; by default a handful of
+    jittered exponential-backoff attempts, with a torn reply line —
+    JSONDecodeError from a pserver that died mid-write — treated like a
+    dropped socket), so a pserver restart is ridden through instead of
+    killing the trainer. Server-side {"error": ...} replies raise
+    RuntimeError without retry. CAUTION: a push retried after the
+    request was sent but before the reply arrived may be applied twice
+    — acceptable for async SGD (one extra gradient step), see
+    KNOWN_GAPS for the sync-barrier caveat."""
 
     def __init__(self, endpoint: str, timeout: Optional[float] = None,
-                 connect_timeout: float = 30.0):
+                 connect_timeout: float = 30.0,
+                 retry: Optional[RetryPolicy] = None):
         """timeout=None blocks indefinitely on replies — required for
         sync (fan-in barrier) pushes, where the reply only arrives once
         the LAST trainer contributes."""
-        self.endpoint = endpoint
-        host, port = endpoint.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=connect_timeout)
-        self._sock.settimeout(timeout)
-        self._file = self._sock.makefile("rwb")
-        self._lock = threading.Lock()
+        policy = retry or RetryPolicy(max_attempts=5, base_delay_s=0.05)
+        super().__init__(endpoint, policy, timeout=timeout,
+                         connect_timeout_s=connect_timeout,
+                         eager_connect=True)  # fail fast on bad endpoint
 
-    def _call(self, req: dict) -> dict:
-        with self._lock:
-            self._file.write((json.dumps(req) + "\n").encode())
-            self._file.flush()
-            line = self._file.readline()
-        if not line:
-            raise ConnectionError("pserver closed connection")
-        resp = json.loads(line)
+    def _handle_resp(self, resp: dict) -> dict:
         if "error" in resp:
             raise RuntimeError(resp["error"])
         return resp
+
+    def _retry_name(self, req: dict) -> str:
+        return f"pserver.{req.get('method', 'rpc')}"
 
     def init_param(self, name, value):
         self._call({"method": "init_param", "name": name,
@@ -460,19 +467,15 @@ class PServerClient:
     def push_grad(self, name, grad, sync=False, num_trainers=1) -> int:
         return self._call({"method": "push_grad", "name": name,
                            "grad": _enc(np.asarray(grad)), "sync": sync,
-                           "num_trainers": num_trainers})["version"]
+                           "num_trainers": num_trainers},
+                          fault_point="pserver.push")["version"]
 
     def push_grad_sparse(self, name, rows, grad_rows) -> int:
         return self._call({"method": "push_grad_sparse", "name": name,
                            "rows": [int(r) for r in rows],
-                           "grad_rows": _enc(np.asarray(grad_rows))}
-                          )["version"]
+                           "grad_rows": _enc(np.asarray(grad_rows))},
+                          fault_point="pserver.push")["version"]
 
     def param_names(self) -> List[str]:
         return self._call({"method": "param_names"})["names"]
 
-    def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass

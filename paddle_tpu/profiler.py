@@ -20,6 +20,9 @@ _enabled = False
 # the serving runtime (paddle_tpu.serving) are tagged so a trace of a
 # live server separates queueing/batching/compile time from model time.
 CAT_SERVING = "serving"
+# Retry/backoff spans from paddle_tpu.resilience.retry: each retry::<op>
+# event covers the backoff sleep before that retry attempt.
+CAT_RESILIENCE = "resilience"
 
 
 class RecordEvent:

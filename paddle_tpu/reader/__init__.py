@@ -216,15 +216,23 @@ def cache(reader):
 
 
 def batch(reader, batch_size: int, drop_last: bool = False):
-    """Group samples into lists of batch_size (reference: paddle.batch)."""
+    """Group samples into lists of batch_size (reference: paddle.batch).
+
+    Fires the `reader.next` fault point once per yielded batch, so chaos
+    tests can make the input pipeline stall (delay_s) or fail mid-pass
+    (see resilience/faults.py; inert when no injector is armed)."""
+    from ..resilience import faults
+
     def batch_reader():
         b = []
         for sample in reader():
             b.append(sample)
             if len(b) == batch_size:
+                faults.fire("reader.next")
                 yield b
                 b = []
         if b and not drop_last:
+            faults.fire("reader.next")
             yield b
     return batch_reader
 

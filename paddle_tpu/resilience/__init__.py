@@ -1,0 +1,42 @@
+"""paddle_tpu.resilience — fault injection, unified retry/backoff, and
+serving health (circuit breaker).
+
+Three pieces, wired through the serving, trainer, and distributed
+layers (see each module's docstring for the design argument):
+
+- `faults`: seed-deterministic FaultInjector with named fault points
+  installed as inert hooks at the failure-prone call sites
+  (checkpoint read/write, master RPC, pserver push, serving batch,
+  reader next, dataset download). Tests arm them in a `with` scope.
+- `retry`: RetryPolicy (exponential backoff + jitter + deadline +
+  retryable-exception filter) shared by MasterClient, PServerClient,
+  checkpoint save/load, and dataset downloads; retries are counted in
+  `retry_counters()` and traced via profiler events.
+- `health`: HealthMonitor + consecutive-failure CircuitBreaker that
+  lets ServingEngine shed load (fast-fail submit) while the model is
+  broken and recover via a half-open probe.
+
+Quick chaos-test sketch::
+
+    from paddle_tpu import resilience
+
+    with resilience.FaultInjector(seed=7) as fi:
+        fi.on("serving.batch", raises=RuntimeError, times=5)
+        ...   # breaker opens after 5 consecutive batch failures,
+        ...   # submit() fast-fails with CircuitOpenError, then the
+        ...   # half-open probe closes it once faults are exhausted
+"""
+from .faults import (FAULT_POINTS, FaultError, FaultInjector,  # noqa: F401
+                     active, fire)
+from .health import (CLOSED, HALF_OPEN, OPEN, PROBE,  # noqa: F401
+                     CircuitBreaker, CircuitOpenError, HealthMonitor)
+from .retry import (DEFAULT_RETRYABLE, RetryError, RetryPolicy,  # noqa: F401
+                    reset_retry_counters, retry_counters)
+
+__all__ = [
+    "FaultInjector", "FaultError", "fire", "active", "FAULT_POINTS",
+    "RetryPolicy", "RetryError", "retry_counters", "reset_retry_counters",
+    "DEFAULT_RETRYABLE",
+    "CircuitBreaker", "CircuitOpenError", "HealthMonitor",
+    "CLOSED", "OPEN", "HALF_OPEN", "PROBE",
+]

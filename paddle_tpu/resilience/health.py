@@ -1,0 +1,214 @@
+"""Serving health: consecutive-failure circuit breaker + rolling health
+monitor.
+
+The PR-1 serving engine kept serving through errors — correct for a
+transient bad batch, wrong for a broken model: every queued request
+burns a worker dispatch only to fail, and clients keep piling on. The
+breaker turns sustained failure into *load shedding*: after
+`failure_threshold` consecutive batch failures the circuit OPENS and
+`ServingEngine.submit()` fast-fails with CircuitOpenError (no queueing,
+no model run). After `reset_timeout_s` the breaker goes HALF_OPEN and
+admits a limited probe; one successful batch closes the circuit, a
+failed probe re-opens it. This is the canonical three-state breaker
+(closed -> open -> half-open), driven by *batch* outcomes because the
+batch is the engine's unit of model execution.
+
+The HealthMonitor composes the breaker with a rolling error-rate window
+and last-error capture, and renders everything JSON-able for
+`ServingEngine.stats()`.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+__all__ = ["CircuitBreaker", "CircuitOpenError", "HealthMonitor",
+           "CLOSED", "OPEN", "HALF_OPEN", "PROBE"]
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+#: truthy sentinel returned by allow_request() when the admission
+#: consumed a half-open probe slot — callers that fail to turn the
+#: request into a batch should release_probe() ONLY in that case
+PROBE = "probe"
+
+
+class CircuitOpenError(RuntimeError):
+    """Fast-fail: the serving circuit is open (load shedding)."""
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker over batch outcomes.
+
+    failure_threshold: consecutive failures that open the circuit.
+    reset_timeout_s:   open -> half-open cooldown.
+    half_open_probes:  requests admitted while half-open (the probe
+                       budget; replenished on each open -> half-open
+                       transition).
+    clock:             injectable monotonic clock for tests.
+    """
+
+    def __init__(self, failure_threshold: int = 5,
+                 reset_timeout_s: float = 5.0,
+                 half_open_probes: int = 1,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self.half_open_probes = int(half_open_probes)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+        self._probe_budget = 0
+        self._probe_taken_at: Optional[float] = None
+        self.opened_total = 0   # times the circuit opened
+        self.shed_total = 0     # requests fast-failed while open
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open_locked()
+            return self._state
+
+    def _maybe_half_open_locked(self):
+        if self._state == OPEN and \
+                self._clock() - self._opened_at >= self.reset_timeout_s:
+            self._state = HALF_OPEN
+            self._probe_budget = self.half_open_probes
+            self._probe_taken_at = None
+        elif self._state == HALF_OPEN and self._probe_budget == 0 \
+                and self._probe_taken_at is not None \
+                and self._clock() - self._probe_taken_at \
+                >= self.reset_timeout_s:
+            # liveness guard: an admitted probe that never produced a
+            # batch outcome (queue-expired, crashed client) would wedge
+            # the breaker half-open with no budget; after a further
+            # cooldown, hand out a fresh probe
+            self._probe_budget = self.half_open_probes
+            self._probe_taken_at = None
+
+    def allow_request(self):
+        """Submit-side gate. Falsy = shed this request now; truthy =
+        admitted (the PROBE sentinel marks an admission that consumed a
+        half-open probe slot and must be release_probe()d if the
+        request never becomes a batch)."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == CLOSED:
+                return True
+            if self._state == HALF_OPEN and self._probe_budget > 0:
+                self._probe_budget -= 1
+                self._probe_taken_at = self._clock()
+                return PROBE
+            self.shed_total += 1
+            return False
+
+    def release_probe(self) -> None:
+        """Return an admitted probe slot whose request never became a
+        batch (e.g. the queue rejected it), so the next request can
+        probe instead of waiting out the liveness guard."""
+        with self._lock:
+            if self._state == HALF_OPEN and \
+                    self._probe_budget < self.half_open_probes:
+                self._probe_budget += 1
+
+    def record_success(self) -> None:
+        """A batch completed: a half-open probe's success closes the
+        circuit; while OPEN, a straggler batch admitted before the trip
+        only resets the streak (cooldown + probe still required)."""
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state == HALF_OPEN:
+                self._state = CLOSED
+                self._opened_at = None
+
+    def record_failure(self) -> None:
+        """A batch failed: re-open a half-open probe immediately, or
+        open once the consecutive-failure streak hits the threshold."""
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._state == HALF_OPEN or (
+                    self._state == CLOSED and
+                    self._consecutive_failures >= self.failure_threshold):
+                self._state = OPEN
+                self._opened_at = self._clock()
+                self._probe_budget = 0
+                self.opened_total += 1
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            self._maybe_half_open_locked()
+            return {
+                "state": self._state,
+                "consecutive_failures": self._consecutive_failures,
+                "failure_threshold": self.failure_threshold,
+                "reset_timeout_s": self.reset_timeout_s,
+                "opened_total": self.opened_total,
+                "shed_total": self.shed_total,
+            }
+
+
+class HealthMonitor:
+    """Rolling batch-outcome window + breaker, one `record_*` call per
+    batch from the serving workers; `snapshot()` is the JSON-able health
+    block in `ServingEngine.stats()`."""
+
+    def __init__(self, breaker: Optional[CircuitBreaker] = None,
+                 window: int = 128):
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self._outcomes = collections.deque(maxlen=window)
+        self._lock = threading.Lock()
+        self._last_error: Optional[str] = None
+        self._last_error_time: Optional[float] = None
+
+    def allow_request(self):
+        return self.breaker.allow_request()
+
+    def release_probe(self) -> None:
+        self.breaker.release_probe()
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._outcomes.append(True)
+        self.breaker.record_success()
+
+    def record_failure(self, exc: Optional[BaseException] = None) -> None:
+        with self._lock:
+            self._outcomes.append(False)
+            if exc is not None:
+                self._last_error = repr(exc)
+                self._last_error_time = time.time()
+        self.breaker.record_failure()
+
+    @property
+    def error_rate(self) -> float:
+        """Failure fraction over the rolling window (0.0 when empty)."""
+        with self._lock:
+            if not self._outcomes:
+                return 0.0
+            return 1.0 - sum(self._outcomes) / len(self._outcomes)
+
+    @property
+    def healthy(self) -> bool:
+        return self.breaker.state == CLOSED
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            n = len(self._outcomes)
+            rate = (1.0 - sum(self._outcomes) / n) if n else 0.0
+            last_error = self._last_error
+            last_error_time = self._last_error_time
+        return {
+            "error_rate": round(rate, 6),
+            "window": n,
+            "last_error": last_error,
+            "last_error_time": last_error_time,
+            "breaker": self.breaker.snapshot(),
+        }

@@ -18,9 +18,13 @@ Quickstart::
 
 Module map: `model.ServableModel` (frozen program + pinned weights),
 `batcher.DynamicBatcher` (bucket padding, deadline/max-batch flush,
-backpressure), `engine.ServingEngine` (workers, warmup, drain),
+backpressure), `engine.ServingEngine` (workers, warmup, drain, and a
+consecutive-failure circuit breaker — open = submit() fast-fails with
+CircuitOpenError, recovery via half-open probe; resilience/health.py),
 `metrics.ServingMetrics` (counters/histograms + stats()).
 """
+from ..resilience.health import (CircuitBreaker, CircuitOpenError,  # noqa
+                                 HealthMonitor)
 from .batcher import (BatchingConfig, DynamicBatcher,  # noqa
                       QueueFullError, ServingFuture, ServingStopped)
 from .engine import ServingEngine  # noqa
@@ -29,7 +33,8 @@ from .model import ServableModel  # noqa
 
 __all__ = ["load", "ServableModel", "ServingEngine", "ServingMetrics",
            "BatchingConfig", "DynamicBatcher", "ServingFuture",
-           "QueueFullError", "ServingStopped"]
+           "QueueFullError", "ServingStopped", "CircuitBreaker",
+           "CircuitOpenError", "HealthMonitor"]
 
 
 def load(dirname, model_filename=None, params_filename=None):

@@ -21,6 +21,9 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from .. import profiler
+from ..resilience import faults
+from ..resilience import health as health_mod
+from ..resilience.health import CircuitOpenError, HealthMonitor
 from .batcher import Batch, BatchingConfig, DynamicBatcher, ServingFuture
 from .metrics import ServingMetrics
 
@@ -30,10 +33,15 @@ __all__ = ["ServingEngine"]
 class ServingEngine:
     def __init__(self, model, config: Optional[BatchingConfig] = None,
                  metrics: Optional[ServingMetrics] = None,
-                 num_workers: int = 1):
+                 num_workers: int = 1,
+                 health: Optional[HealthMonitor] = None):
         self.model = model
         self.config = config or BatchingConfig()
         self.metrics = metrics or ServingMetrics()
+        # consecutive-failure circuit breaker: a broken model trips it
+        # OPEN and submit() fast-fails (load shedding) until a half-open
+        # probe batch succeeds — see resilience/health.py
+        self.health = health or HealthMonitor()
         self.batcher = DynamicBatcher(model.feed_specs, self.config,
                                       self.metrics)
         self.num_workers = int(num_workers)
@@ -119,7 +127,22 @@ class ServingEngine:
             raise RuntimeError(
                 "engine not started — call engine.start() first "
                 "(a request submitted now would wait forever)")
-        return self.batcher.submit(feed)
+        admit = self.health.allow_request()
+        if not admit:   # already counted in the breaker's shed_total
+            raise CircuitOpenError(
+                "serving circuit is open (consecutive batch failures "
+                "tripped the breaker) — request shed; see "
+                "engine.stats()['health']")
+        try:
+            return self.batcher.submit(feed)
+        except BaseException:
+            # the admitted request never reached a batch (bad feed,
+            # queue full): if it held the half-open probe slot, hand it
+            # back instead of wedging the breaker — but only then, so a
+            # non-probe failure can't mint a second concurrent probe
+            if admit is health_mod.PROBE:
+                self.health.release_probe()
+            raise
 
     def predict(self, feed: Dict[str, Any],
                 timeout: Optional[float] = None):
@@ -138,6 +161,9 @@ class ServingEngine:
         out["workers"] = len(self._threads)
         out["started"] = self._started
         out["stopped"] = self._stopped
+        out["health"] = self.health.snapshot()
+        # convenience alias; the breaker's counter is the single source
+        out["shed"] = out["health"]["breaker"]["shed_total"]
         return out
 
     # -- worker ------------------------------------------------------------
@@ -154,13 +180,16 @@ class ServingEngine:
             with profiler.RecordEvent(
                     f"serving::batch_run[{batch.bucket_rows}]",
                     cat=profiler.CAT_SERVING):
+                faults.fire("serving.batch")
                 fetches = self.model.run_direct(batch.feed)
         except BaseException as e:  # deliver failures, keep serving
             self.metrics.errors.inc(len(batch.requests))
+            self.health.record_failure(e)
             for req in batch.requests:
                 req.future.set_exception(e)
             return
         t1 = time.monotonic()
+        self.health.record_success()
         for req, (i0, i1) in zip(batch.requests, batch.slices):
             out = []
             for f, per_row in zip(fetches, self._per_row_fetch):

@@ -93,6 +93,9 @@ class ServingMetrics:
     """All serving-side observability in one place.
 
     - requests/rejections/timeouts/errors: request-level counters
+      (breaker-shed requests are counted by the CircuitBreaker itself
+      — one source of truth — and surfaced as stats()["shed"] by the
+      engine)
     - batches: batch-level counter; batch_fill_ratio: real rows / bucket
       rows per flushed batch (1.0 = no padding waste)
     - queue_depth: rows waiting, sampled on every submit/flush

@@ -41,11 +41,27 @@ class EndIteration:
 
 
 class CheckpointConfig:
+    """Periodic elastic checkpointing.
+
+    retry:    optional resilience.RetryPolicy for the checkpoint I/O
+              (each save's tmp-write phase retries as a unit).
+    on_error: "warn" (default) — a save that still fails after retries
+              is logged and counted (Trainer.checkpoint_failures) but
+              does NOT kill training; the previous valid checkpoint
+              remains the resume point. "raise" restores the old
+              fail-stop behaviour.
+    """
+
     def __init__(self, dirname: str, every_n_batches: int = 100,
-                 max_keep: int = 3):
+                 max_keep: int = 3, retry=None, on_error: str = "warn"):
+        if on_error not in ("warn", "raise"):
+            raise ValueError(f"on_error must be 'warn' or 'raise', "
+                             f"got {on_error!r}")
         self.dirname = dirname
         self.every_n_batches = every_n_batches
         self.max_keep = max_keep
+        self.retry = retry
+        self.on_error = on_error
 
 
 class Trainer:
@@ -77,6 +93,8 @@ class Trainer:
             self._feeder = DataFeeder(vars_, **(feeder_kwargs or {}))
         self._started = False
         self.step = 0
+        self.checkpoint_failures = 0
+        self.last_checkpoint_error = None
 
     # -- lifecycle --------------------------------------------------------
     def start(self, resume: bool = True):
@@ -87,7 +105,8 @@ class Trainer:
             from .distributed.checkpoint import load_checkpoint
             meta = load_checkpoint(self.checkpoint_config.dirname,
                                    main_program=self.main_program,
-                                   executor=self.exe)
+                                   executor=self.exe,
+                                   retry=self.checkpoint_config.retry)
             if meta:
                 self.step = int(meta.get("step", 0))
         self._started = True
@@ -202,9 +221,24 @@ class Trainer:
         if cc and (self.step // cc.every_n_batches
                    > (self.step - advanced) // cc.every_n_batches):
             from .distributed.checkpoint import save_checkpoint
-            save_checkpoint(cc.dirname, step=self.step,
-                            main_program=self.main_program,
-                            executor=self.exe, max_keep=cc.max_keep)
+            try:
+                save_checkpoint(cc.dirname, step=self.step,
+                                main_program=self.main_program,
+                                executor=self.exe, max_keep=cc.max_keep,
+                                retry=cc.retry)
+            except Exception as e:
+                # checkpointing is off the training math path: a failed
+                # save (after retries) must not kill the run — the last
+                # valid checkpoint stays the resume point
+                self.checkpoint_failures += 1
+                self.last_checkpoint_error = e
+                if cc.on_error == "raise":
+                    raise
+                import warnings
+                warnings.warn(
+                    f"checkpoint save at step {self.step} failed "
+                    f"({e!r}); training continues, resume point is the "
+                    "previous valid checkpoint", RuntimeWarning)
 
     # -- evaluation -------------------------------------------------------
     def test(self, reader: Callable[[], Iterable],

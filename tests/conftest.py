@@ -21,6 +21,9 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running (subprocess compile) tests")
+    config.addinivalue_line(
+        "markers", "chaos: seeded fault-injection tests (deterministic, "
+        "fast — they run in tier-1)")
 
 
 @pytest.fixture(autouse=True)
@@ -30,3 +33,16 @@ def fresh_programs():
     pt.reset_default_programs()
     pt.reset_global_scope()
     yield
+
+
+@pytest.fixture(autouse=True)
+def no_fault_injector_leak():
+    """The FaultInjector must be inert outside an explicit scope: no test
+    may start with one armed, and none may leak one (chaos in one test
+    must never bleed into the next)."""
+    from paddle_tpu.resilience import faults
+    assert faults.active() is None, \
+        "a FaultInjector leaked from a previous test"
+    yield
+    assert faults.active() is None, \
+        "test left a FaultInjector installed"

@@ -1,0 +1,343 @@
+"""Composed chaos suite (ISSUE 2 acceptance): the resilience layer under
+deterministic injected faults.
+
+(a) trainer completes with bit-identical final weights after injected
+    checkpoint-write failures, and resumes from the last valid
+    checkpoint;
+(b) ServingEngine's breaker opens after N consecutive batch failures,
+    fast-fails (sheds) while open, and recovers via a half-open probe;
+(c) MasterClient completes its task loop through >= 3 injected
+    connection drops with backoff (observed retry counter > 0);
+plus the pserver push path riding injected drops.
+
+All tests are seeded (FaultInjector seed + seeded programs/readers) and
+fast enough for tier-1.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, serving
+from paddle_tpu.resilience import (CircuitBreaker, CircuitOpenError,
+                                   FaultInjector, HealthMonitor,
+                                   RetryPolicy, faults)
+from paddle_tpu.trainer import CheckpointConfig, Trainer
+
+pytestmark = pytest.mark.chaos
+
+
+# -- (a) trainer vs checkpoint-write faults --------------------------------
+
+def _build_regression(seed=11):
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = seed
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [6], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, size=1, bias_attr=False)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _reader(n_batches=8, bs=8, seed=5):
+    rng = np.random.RandomState(seed)
+    W = rng.randn(6, 1).astype(np.float32)
+
+    def read():
+        r = np.random.RandomState(seed + 1)
+        for _ in range(n_batches):
+            x = r.randn(bs, 6).astype(np.float32)
+            yield {"x": x, "y": x @ W}
+    return read
+
+
+def _final_weights(main):
+    return {p.name: np.asarray(pt.global_scope().get(p.name)).copy()
+            for p in main.all_parameters()}
+
+
+def test_trainer_survives_checkpoint_write_faults(tmp_path):
+    # ONE program (seeded init), two runs over a fresh scope each: the
+    # reference run has no faults and no checkpointing
+    main, startup, loss = _build_regression()
+    t = Trainer(loss, main_program=main, startup_program=startup)
+    t.train(num_passes=2, reader=_reader())
+    want = _final_weights(main)
+
+    # chaos run: same program + reader, checkpoint every 4 steps, the
+    # first three write attempts fail (save@4 exhausts its 2 attempts
+    # and is dropped; save@8 fails once then succeeds on retry; @12 and
+    # @16 are clean)
+    pt.reset_global_scope()
+    d = str(tmp_path / "ck")
+    cc = CheckpointConfig(
+        d, every_n_batches=4,
+        retry=RetryPolicy(max_attempts=2, base_delay_s=0.001, jitter=0.0))
+    t2 = Trainer(loss, main_program=main, startup_program=startup,
+                 checkpoint_config=cc)
+    with FaultInjector(seed=0) as fi, pytest.warns(RuntimeWarning):
+        fi.on("checkpoint.write", raises=IOError, times=3)
+        t2.train(num_passes=2, reader=_reader())
+        assert fi.triggered("checkpoint.write") == 3
+    assert t2.step == 16
+    assert t2.checkpoint_failures == 1          # only save@4 was lost
+    got = _final_weights(main)
+    for name, w in want.items():                # faults never touched math
+        np.testing.assert_array_equal(got[name], w)
+
+    # the last valid checkpoint is the resume point
+    from paddle_tpu.distributed.checkpoint import latest_checkpoint
+    found = latest_checkpoint(d)
+    assert found is not None and found[1]["step"] == 16
+    assert not [n for n in os.listdir(d) if n.endswith(".tmp")]
+    pt.reset_global_scope()
+    t3 = Trainer(loss, main_program=main, startup_program=startup,
+                 checkpoint_config=CheckpointConfig(d, every_n_batches=4))
+    t3.start(resume=True)
+    assert t3.step == 16
+    resumed = _final_weights(main)
+    for name, w in got.items():
+        np.testing.assert_array_equal(resumed[name], w)
+
+
+def test_trainer_checkpoint_on_error_raise_restores_fail_stop(tmp_path):
+    main, startup, loss = _build_regression()
+    cc = CheckpointConfig(str(tmp_path / "ck"), every_n_batches=4,
+                          on_error="raise")
+    t = Trainer(loss, main_program=main, startup_program=startup,
+                checkpoint_config=cc)
+    with FaultInjector() as fi:
+        fi.on("checkpoint.write", raises=IOError)
+        with pytest.raises(IOError):
+            t.train(num_passes=1, reader=_reader())
+
+
+# -- (b) serving circuit breaker -------------------------------------------
+
+def _freeze_mlp(tmp_path, seed=0):
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = seed
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8], dtype="float32")
+        pred = layers.fc(x, size=3, act="softmax")
+    exe = pt.Executor()
+    exe.run(startup)
+    dirname = str(tmp_path / "model")
+    pt.io.save_inference_model(dirname, ["x"], [pred], exe, main)
+    return dirname
+
+
+def test_serving_breaker_opens_sheds_and_recovers(tmp_path):
+    model = serving.load(_freeze_mlp(tmp_path))
+    threshold = 3
+    engine = serving.ServingEngine(
+        model,
+        serving.BatchingConfig(max_batch_size=2, batch_buckets=[2],
+                               max_latency_ms=1.0),
+        health=HealthMonitor(CircuitBreaker(failure_threshold=threshold,
+                                            reset_timeout_s=0.2)))
+    engine.start(warmup=False)
+    feed = {"x": np.random.RandomState(0).rand(2, 8).astype(np.float32)}
+    try:
+        with FaultInjector(seed=0) as fi:
+            fi.on("serving.batch", raises=RuntimeError, times=threshold)
+            # N consecutive poisoned batches -> breaker opens
+            for _ in range(threshold):
+                with pytest.raises(RuntimeError):
+                    engine.predict(feed, timeout=30)
+            assert fi.triggered("serving.batch") == threshold
+            assert engine.stats()["health"]["breaker"]["state"] == "open"
+
+            # open = fast-fail at submit: no queueing, no model run
+            calls_before = fi.calls("serving.batch")
+            t0 = time.monotonic()
+            for _ in range(4):
+                with pytest.raises(CircuitOpenError):
+                    engine.submit(feed)
+            assert time.monotonic() - t0 < 0.1        # shed, not queued
+            assert fi.calls("serving.batch") == calls_before
+            st = engine.stats()
+            assert st["shed"] == 4
+            assert st["health"]["breaker"]["shed_total"] == 4
+
+            # cooldown -> half-open -> successful probe closes it
+            # (the injector's schedule is exhausted: the model is healthy)
+            time.sleep(0.25)
+            (out,) = engine.predict(feed, timeout=30)
+            assert out.shape == (2, 3)
+            st = engine.stats()
+            assert st["health"]["breaker"]["state"] == "closed"
+            assert st["health"]["breaker"]["opened_total"] == 1
+            # and stays closed for regular traffic
+            engine.predict(feed, timeout=30)
+            assert engine.health.healthy
+    finally:
+        engine.stop()
+    assert engine.stats()["errors"] == threshold  # one request per batch
+
+
+def test_serving_failed_probe_reopens(tmp_path):
+    model = serving.load(_freeze_mlp(tmp_path))
+    engine = serving.ServingEngine(
+        model,
+        serving.BatchingConfig(max_batch_size=1, batch_buckets=[1],
+                               max_latency_ms=1.0),
+        health=HealthMonitor(CircuitBreaker(failure_threshold=2,
+                                            reset_timeout_s=0.1)))
+    engine.start(warmup=False)
+    feed = {"x": np.zeros((1, 8), np.float32)}
+    try:
+        with FaultInjector() as fi:
+            fi.on("serving.batch", raises=RuntimeError, times=3)
+            for _ in range(2):
+                with pytest.raises(RuntimeError):
+                    engine.predict(feed, timeout=30)
+            assert engine.stats()["health"]["breaker"]["state"] == "open"
+            time.sleep(0.15)
+            # half-open probe hits the third injected fault -> reopen
+            with pytest.raises(RuntimeError):
+                engine.predict(feed, timeout=30)
+            br = engine.stats()["health"]["breaker"]
+            assert br["state"] == "open" and br["opened_total"] == 2
+            # next cooldown's probe succeeds (faults exhausted)
+            time.sleep(0.15)
+            engine.predict(feed, timeout=30)
+            assert engine.stats()["health"]["breaker"]["state"] == "closed"
+    finally:
+        engine.stop()
+
+
+# -- (c) master client through connection drops ----------------------------
+
+def test_master_client_rides_injected_connection_drops():
+    from paddle_tpu.distributed import Master, MasterClient, MasterServer
+
+    master = Master(timeout_s=60, failure_max=3)
+    tasks = [f"shard{i}".encode() for i in range(6)]
+    master.set_dataset(tasks)
+    server = MasterServer(master).start()
+    try:
+        client = MasterClient(
+            server.endpoint,
+            retry=RetryPolicy(max_attempts=8, base_delay_s=0.005,
+                              jitter=0.0))
+        seen = []
+        with FaultInjector(seed=0) as fi:
+            fi.on("master.rpc", raises=ConnectionError, every=4)
+            for rec in client.task_reader(
+                    lambda payload: [payload.decode()]):
+                seen.append(rec)
+            drops = fi.triggered("master.rpc")
+        assert sorted(seen) == sorted(t.decode() for t in tasks)
+        assert drops >= 3                    # >= 3 injected drops ridden
+        assert client.retries >= drops       # backoff retries observed
+        assert master.counts()["done"] == 6
+    finally:
+        server.shutdown()
+
+
+def test_master_client_survives_real_server_restart(tmp_path):
+    """Not just injected exceptions: the server process vanishes between
+    RPCs (socket drops for real) and comes back on the same endpoint."""
+    from paddle_tpu.distributed import Master, MasterClient, MasterServer
+
+    snap = str(tmp_path / "master.snap")
+    master = Master(timeout_s=60, failure_max=3, snapshot_path=snap,
+                    snapshot_interval_s=0.0)
+    master.set_dataset([b"t0", b"t1", b"t2"])
+    server = MasterServer(master).start()
+    host, port = server.endpoint.rsplit(":", 1)
+    client = MasterClient(server.endpoint,
+                          retry=RetryPolicy(max_attempts=20,
+                                            base_delay_s=0.02, jitter=0.0))
+    payload, tid, epoch = client.get_task()
+    assert payload is not None
+    assert client.task_finished(tid, epoch)
+
+    # the master host dies: the listener goes away AND the established
+    # connection drops (shutdown() alone leaves accepted sockets served
+    # by their daemon handler threads, so sever it explicitly)
+    server.shutdown()
+    client._close()
+
+    import threading
+    restarted = {}
+
+    def restart_later():
+        time.sleep(0.2)                     # refused connections first
+        m2 = Master(snapshot_path=snap)     # recovers snapshotted state
+        restarted["master"] = m2
+        restarted["server"] = MasterServer(
+            m2, host=host, port=int(port)).start()
+
+    th = threading.Thread(target=restart_later)
+    th.start()
+    try:
+        done = 1
+        while True:
+            payload, tid, epoch = client.get_task()
+            if payload is None:
+                break
+            client.task_finished(tid, epoch)
+            done += 1
+        assert done == 3
+        assert client.retries > 0           # backed off through the gap
+        assert restarted["master"].counts()["done"] == 3
+    finally:
+        th.join()
+        if "server" in restarted:
+            restarted["server"].shutdown()
+
+
+# -- pserver push through drops --------------------------------------------
+
+def test_pserver_client_rides_injected_push_drops():
+    from paddle_tpu.distributed import (AsyncParameterServer,
+                                        PServerClient, PServerServer)
+
+    ps = AsyncParameterServer(optimizer="sgd", lr=0.1)
+    server = PServerServer(ps).start()
+    try:
+        client = PServerClient(
+            server.endpoint,
+            retry=RetryPolicy(max_attempts=6, base_delay_s=0.005,
+                              jitter=0.0))
+        w0 = np.ones((4, 2), np.float32)
+        client.init_param("w", w0)
+        client.finish_init()
+        grad = np.full((4, 2), 0.5, np.float32)
+        with FaultInjector(seed=0) as fi:
+            fi.on("pserver.push", raises=ConnectionError, every=3)
+            versions = [client.push_grad("w", grad) for _ in range(6)]
+            drops = fi.triggered("pserver.push")
+        assert versions == [1, 2, 3, 4, 5, 6]    # every push applied once
+        assert drops >= 2 and client.retries >= drops
+        # an application-level error (complete reply, stream in sync)
+        # must NOT tear down the healthy connection
+        with pytest.raises(RuntimeError):
+            client.get_param("unknown-param")
+        assert client._sock is not None
+        np.testing.assert_allclose(client.get_param("w"),
+                                   w0 - 0.1 * 0.5 * 6, rtol=1e-6)
+    finally:
+        server.shutdown()
+
+
+# -- reader fault point ----------------------------------------------------
+
+def test_reader_next_fault_point_delays_and_fails():
+    data = list(range(10))
+    r = pt.reader.batch(lambda: iter(data), batch_size=2)
+    with FaultInjector() as fi:
+        fi.on("reader.next", raises=RuntimeError, after=3, times=1)
+        out = []
+        with pytest.raises(RuntimeError):
+            for b in r():
+                out.append(b)
+        assert out == [[0, 1], [2, 3], [4, 5]]   # failed on the 4th batch
+    # inert afterwards: full pass
+    assert sum(len(b) for b in r()) == 10

@@ -1,0 +1,639 @@
+"""Unit tests for paddle_tpu.resilience (ISSUE 2): FaultInjector
+schedules + inertness, RetryPolicy backoff/deadline/filtering/counters,
+CircuitBreaker/HealthMonitor state machine, download retry with
+partial-file cleanup, and the checkpoint corruption matrix."""
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, profiler, resilience
+from paddle_tpu.resilience import (CircuitBreaker, CircuitOpenError,
+                                   FaultInjector, HealthMonitor,
+                                   RetryError, RetryPolicy, faults)
+
+
+# -- FaultInjector ---------------------------------------------------------
+
+def test_fire_is_inert_without_injector():
+    assert faults.active() is None
+    for _ in range(10):
+        faults.fire("serving.batch")  # must be a no-op, not an error
+
+
+def test_fault_injector_disabled_overhead_and_no_leak():
+    # zero overhead claim: the disabled hook is one global read + None
+    # test. 200k calls in well under a second leaves ~50x headroom over
+    # the observed cost, while still catching an accidentally armed
+    # default or lock acquisition on the hot path.
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        faults.fire("reader.next")
+    assert time.perf_counter() - t0 < 1.0
+    # scopes restore the previous injector exactly (nesting included)
+    outer = FaultInjector(seed=0)
+    inner = FaultInjector(seed=1)
+    with outer:
+        assert faults.active() is outer
+        with inner:
+            assert faults.active() is inner
+        assert faults.active() is outer
+    assert faults.active() is None
+
+
+def test_one_shot_and_every_nth_schedules():
+    with FaultInjector() as fi:
+        fi.on("master.rpc", raises=ConnectionError, times=1)  # one-shot
+        with pytest.raises(ConnectionError):
+            faults.fire("master.rpc")
+        for _ in range(5):
+            faults.fire("master.rpc")  # exhausted
+        assert fi.triggered("master.rpc") == 1
+        assert fi.calls("master.rpc") == 6
+
+    with FaultInjector() as fi:
+        fi.on("pserver.push", raises=OSError, every=3)
+        outcomes = []
+        for _ in range(9):
+            try:
+                faults.fire("pserver.push")
+                outcomes.append("ok")
+            except OSError:
+                outcomes.append("err")
+        assert outcomes == ["ok", "ok", "err"] * 3
+
+
+def test_after_and_probabilistic_schedules_are_seed_deterministic():
+    def run(seed):
+        with FaultInjector(seed=seed) as fi:
+            fi.on("serving.batch", raises=RuntimeError, probability=0.5)
+            out = []
+            for _ in range(20):
+                try:
+                    faults.fire("serving.batch")
+                    out.append(0)
+                except RuntimeError:
+                    out.append(1)
+            return out
+
+    a, b = run(123), run(123)
+    assert a == b                     # same seed, same schedule
+    assert 0 < sum(a) < 20            # actually probabilistic
+    assert run(321) != a              # seed matters
+
+    with FaultInjector() as fi:
+        fi.on("checkpoint.write", raises=IOError, after=2)
+        faults.fire("checkpoint.write")
+        faults.fire("checkpoint.write")   # first two pass
+        with pytest.raises(IOError):
+            faults.fire("checkpoint.write")
+
+
+def test_delay_and_exception_instance():
+    marker = ValueError("specific instance")
+    with FaultInjector() as fi:
+        fi.on("reader.next", delay_s=0.02, raises=marker, times=1)
+        t0 = time.perf_counter()
+        with pytest.raises(ValueError) as ei:
+            faults.fire("reader.next")
+        assert ei.value is marker
+        assert time.perf_counter() - t0 >= 0.02
+
+
+def test_unknown_point_rejected_unless_unchecked():
+    fi = FaultInjector()
+    with pytest.raises(ValueError):
+        fi.on("no.such.point", raises=RuntimeError)
+    fi.on("no.such.point", raises=RuntimeError, unchecked=True)
+
+
+def test_bare_rule_injects_fault_error():
+    from paddle_tpu.resilience import FaultError
+    with FaultInjector() as fi:
+        fi.on("serving.batch", times=1)      # no raises=, no delay_s=
+        with pytest.raises(FaultError):
+            faults.fire("serving.batch")
+        faults.fire("serving.batch")         # one-shot exhausted
+        assert fi.triggered("serving.batch") == 1
+
+
+# -- RetryPolicy -----------------------------------------------------------
+
+def test_retry_backoff_sequence_and_cap():
+    slept = []
+    p = RetryPolicy(max_attempts=6, base_delay_s=0.1, multiplier=2.0,
+                    max_delay_s=0.5, jitter=0.0, sleep=slept.append)
+    attempts = []
+
+    def always_fails():
+        attempts.append(1)
+        raise ConnectionError("nope")
+
+    with pytest.raises(ConnectionError):
+        p.call(always_fails, name="t_backoff")
+    assert len(attempts) == 6
+    # exponential then capped: 0.1, 0.2, 0.4, 0.5, 0.5
+    assert slept == pytest.approx([0.1, 0.2, 0.4, 0.5, 0.5])
+
+
+def test_retry_jitter_bounded_and_seed_deterministic():
+    def delays(seed):
+        p = RetryPolicy(base_delay_s=0.1, jitter=0.2, seed=seed,
+                        max_delay_s=10.0)
+        return [p.delay(i) for i in range(4)]
+
+    d1, d2 = delays(7), delays(7)
+    assert d1 == d2
+    for i, d in enumerate(d1):
+        nominal = 0.1 * 2 ** i
+        assert 0.8 * nominal <= d <= 1.2 * nominal
+    assert delays(8) != d1
+
+
+def test_retry_non_retryable_propagates_immediately():
+    p = RetryPolicy(max_attempts=5, base_delay_s=0.0,
+                    retryable=(ConnectionError,))
+    attempts = []
+
+    def fails():
+        attempts.append(1)
+        raise KeyError("not transient")
+
+    with pytest.raises(KeyError):
+        p.call(fails, name="t_filter")
+    assert len(attempts) == 1
+
+    # predicate form
+    p2 = RetryPolicy(max_attempts=3, base_delay_s=0.0, sleep=lambda s: None,
+                     retryable=lambda e: "transient" in str(e))
+    attempts2 = []
+
+    def fails2():
+        attempts2.append(1)
+        raise RuntimeError("transient glitch")
+
+    with pytest.raises(RuntimeError):
+        p2.call(fails2, name="t_pred")
+    assert len(attempts2) == 3
+
+
+def test_retry_deadline_raises_retry_error():
+    now = [0.0]
+    p = RetryPolicy(max_attempts=100, base_delay_s=1.0, jitter=0.0,
+                    deadline_s=2.5, sleep=lambda s: now.__setitem__(
+                        0, now[0] + s), clock=lambda: now[0])
+
+    def fails():
+        raise ConnectionError("down")
+
+    with pytest.raises(RetryError) as ei:
+        p.call(fails, name="t_deadline")
+    assert isinstance(ei.value.__cause__, ConnectionError)
+
+
+def test_retry_counters_and_profiler_events():
+    resilience.reset_retry_counters()
+    calls = []
+    p = RetryPolicy(max_attempts=4, base_delay_s=0.001, jitter=0.0)
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("x")
+        return "ok"
+
+    profiler.start_profiler()
+    try:
+        assert p.call(flaky, name="unit.flaky") == "ok"
+    finally:
+        profiler.stop_profiler()
+    c = resilience.retry_counters()["unit.flaky"]
+    assert c == {"calls": 1, "retries": 2, "failures": 0}
+    evs = profiler.events(cat=profiler.CAT_RESILIENCE)
+    assert sum(e["name"] == "retry::unit.flaky" for e in evs) == 2
+
+
+def test_retry_wrap_decorates_with_policy():
+    resilience.reset_retry_counters()
+    p = RetryPolicy(max_attempts=3, base_delay_s=0.0, sleep=lambda s: None)
+    state = {"n": 0}
+    hooks = []
+
+    def flaky(x, y=1):
+        """docstring survives"""
+        state["n"] += 1
+        if state["n"] < 2:
+            raise ConnectionError("transient")
+        return x + y
+
+    wrapped = p.wrap(flaky, name="unit.wrapped",
+                     on_retry=lambda i, e: hooks.append(i))
+    assert wrapped(2, y=3) == 5
+    assert wrapped.__name__ == "flaky" and "survives" in wrapped.__doc__
+    assert hooks == [0]
+    c = resilience.retry_counters()["unit.wrapped"]
+    assert c["calls"] == 1 and c["retries"] == 1
+
+
+def test_retry_on_retry_hook_sees_each_failure():
+    seen = []
+    p = RetryPolicy(max_attempts=3, base_delay_s=0.0, sleep=lambda s: None)
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise ConnectionError(f"fail{state['n']}")
+        return state["n"]
+
+    assert p.call(flaky, name="t_hook",
+                  on_retry=lambda i, e: seen.append((i, str(e)))) == 3
+    assert seen == [(0, "fail1"), (1, "fail2")]
+
+
+# -- CircuitBreaker / HealthMonitor ---------------------------------------
+
+def test_breaker_state_machine_with_virtual_clock():
+    now = [0.0]
+    b = CircuitBreaker(failure_threshold=3, reset_timeout_s=10.0,
+                       clock=lambda: now[0])
+    assert b.state == "closed" and b.allow_request()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"          # below threshold
+    b.record_success()                  # success resets the streak
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == "open"
+    assert not b.allow_request()        # shedding
+    assert b.shed_total == 1
+    now[0] += 10.0
+    assert b.state == "half_open"
+    assert b.allow_request()            # the probe
+    assert not b.allow_request()        # probe budget exhausted
+    b.record_failure()                  # probe failed -> reopen
+    assert b.state == "open" and b.opened_total == 2
+    now[0] += 10.0
+    assert b.allow_request()
+    b.record_success()                  # probe succeeded -> closed
+    assert b.state == "closed"
+    assert b.allow_request()
+
+
+def test_breaker_straggler_success_while_open_does_not_close():
+    now = [0.0]
+    b = CircuitBreaker(failure_threshold=2, reset_timeout_s=10.0,
+                       clock=lambda: now[0])
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "open"
+    # a batch admitted before the trip completes late: the streak
+    # resets but the circuit must still wait out cooldown + probe
+    b.record_success()
+    assert b.state == "open"
+    assert not b.allow_request()
+    now[0] += 10.0
+    assert b.allow_request()            # the probe
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_breaker_released_and_lost_probes_do_not_wedge_half_open():
+    now = [0.0]
+    b = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0,
+                       clock=lambda: now[0])
+    b.record_failure()
+    now[0] += 5.0
+    assert b.state == "half_open"
+    # a probe admission is marked with the PROBE sentinel (so callers
+    # release only slots they actually held); closed admissions are a
+    # plain True
+    from paddle_tpu.resilience import PROBE
+    assert b.allow_request() is PROBE
+    assert not b.allow_request()
+    b.release_probe()
+    assert b.allow_request()
+    # a probe lost entirely (no outcome, no release) self-heals after
+    # another cooldown instead of shedding forever
+    assert not b.allow_request()
+    now[0] += 5.0
+    assert b.allow_request()
+    b.record_success()
+    assert b.state == "closed"
+    # release_probe outside half-open is a no-op
+    b.release_probe()
+    assert b.state == "closed"
+
+
+def test_retryable_accepts_bare_exception_class():
+    p = RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                    sleep=lambda s: None, retryable=ConnectionError)
+    attempts = []
+
+    def fails_value_error():
+        attempts.append(1)
+        raise ValueError("not transient — must NOT retry")
+
+    with pytest.raises(ValueError):
+        p.call(fails_value_error, name="t_bare")
+    assert len(attempts) == 1
+
+    attempts2 = []
+
+    def flaky():
+        attempts2.append(1)
+        if len(attempts2) < 2:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert p.call(flaky, name="t_bare2") == "ok"
+
+
+def test_health_monitor_error_rate_and_snapshot():
+    hm = HealthMonitor(CircuitBreaker(failure_threshold=100), window=10)
+    for _ in range(6):
+        hm.record_success()
+    for _ in range(4):
+        hm.record_failure(RuntimeError("boom"))
+    assert hm.error_rate == pytest.approx(0.4)
+    assert hm.healthy
+    snap = hm.snapshot()
+    assert snap["window"] == 10
+    assert "boom" in snap["last_error"]
+    assert snap["breaker"]["state"] == "closed"
+    json.dumps(snap)  # JSON-able
+
+
+# -- JSON-lines transport --------------------------------------------------
+
+def test_torn_reply_is_a_transport_error():
+    """A partial JSON reply (server died mid-write) must surface as
+    ConnectionError from the transport, so EVERY retry policy treats it
+    as retryable without knowing the wire format."""
+    import socket as socket_mod
+    from paddle_tpu.distributed.jsonrpc import JSONLinesClient
+
+    a, b = socket_mod.socketpair()
+    try:
+        c = JSONLinesClient("host:1", RetryPolicy(max_attempts=1))
+        c._sock = a
+        c._file = a.makefile("rwb")
+        b.sendall(b'{"truncated": \n')   # torn line from a dying server
+        with pytest.raises(ConnectionError) as ei:
+            c._attempt({"method": "x"}, None)
+        assert "torn reply" in str(ei.value)
+    finally:
+        a.close()
+        b.close()
+
+
+# -- dataset download: retry + partial-file hygiene ------------------------
+
+def _patch_data_home(monkeypatch, tmp_path):
+    from paddle_tpu.dataset import common
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path / "cache"))
+    return common
+
+
+def test_download_retries_and_cleans_partial_file(monkeypatch, tmp_path):
+    common = _patch_data_home(monkeypatch, tmp_path)
+    payload = b"archive-bytes"
+    md5 = __import__("hashlib").md5(payload).hexdigest()
+    state = {"n": 0}
+    part_paths = []
+
+    def fetch(url, path):
+        state["n"] += 1
+        # every attempt gets its own fresh (empty) temp file, so
+        # concurrent downloaders can never interleave into one .part
+        assert path not in part_paths and os.path.getsize(path) == 0
+        part_paths.append(path)
+        with open(path, "wb") as f:
+            if state["n"] < 3:
+                f.write(payload[:4])         # truncated transfer...
+                raise ConnectionError("link dropped mid-transfer")
+            f.write(payload)
+
+    p = common.download("http://example.invalid/data.tgz", "unit",
+                        md5sum=md5,
+                        retry=RetryPolicy(max_attempts=3,
+                                          base_delay_s=0.001, jitter=0.0),
+                        fetch=fetch)
+    assert state["n"] == 3 and len(set(part_paths)) == 3
+    with open(p, "rb") as f:
+        assert f.read() == payload
+    # no .part residue anywhere in the cache dir
+    assert not [f for f in os.listdir(os.path.dirname(p))
+                if f.endswith(".part")]
+
+
+def test_download_discards_corrupt_cache_and_md5_failure(monkeypatch,
+                                                         tmp_path):
+    common = _patch_data_home(monkeypatch, tmp_path)
+    payload = b"real-data"
+    md5 = __import__("hashlib").md5(payload).hexdigest()
+    fname = common.cache_path("unit", "f.bin")
+    os.makedirs(os.path.dirname(fname))
+    with open(fname, "wb") as f:
+        f.write(b"corrupt-cached-copy")
+
+    def fetch(url, path):
+        with open(path, "wb") as f:
+            f.write(payload)
+
+    # corrupt cached file is discarded, re-fetched, verified
+    p = common.download("http://example.invalid/f.bin", "unit",
+                        md5sum=md5, retry=RetryPolicy(max_attempts=1),
+                        fetch=fetch)
+    with open(p, "rb") as f:
+        assert f.read() == payload
+
+    # a transfer that never matches md5 exhausts retries and leaves
+    # NOTHING cached (neither final nor partial file)
+    def bad_fetch(url, path):
+        with open(path, "wb") as f:
+            f.write(b"garbage")
+
+    with pytest.raises(IOError):
+        common.download("http://example.invalid/g.bin", "unit",
+                        md5sum=md5,
+                        retry=RetryPolicy(max_attempts=2,
+                                          base_delay_s=0.001, jitter=0.0),
+                        fetch=bad_fetch)
+    assert not os.path.exists(common.cache_path("unit", "g.bin"))
+    assert not [f for f in os.listdir(common.cache_path("unit"))
+                if f.endswith(".part")]
+
+
+def test_download_fault_point(monkeypatch, tmp_path):
+    common = _patch_data_home(monkeypatch, tmp_path)
+
+    def fetch(url, path):
+        with open(path, "wb") as f:
+            f.write(b"x")
+
+    with FaultInjector() as fi:
+        fi.on("dataset.download", raises=ConnectionError, times=1)
+        p = common.download(
+            "http://example.invalid/h.bin", "unit",
+            retry=RetryPolicy(max_attempts=2, base_delay_s=0.001,
+                              jitter=0.0), fetch=fetch)
+        assert fi.triggered("dataset.download") == 1
+    assert os.path.exists(p)
+
+
+# -- checkpoint hygiene + corruption matrix --------------------------------
+
+def _build_with_param(value: float, seed: int = 3):
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = seed
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        layers.fc(x, size=2, bias_attr=False)
+    exe = pt.Executor()
+    exe.run(startup)
+    return main, exe
+
+
+def _set_param(main, value: float):
+    scope = pt.global_scope()
+    pname = main.all_parameters()[0].name
+    cur = np.asarray(scope.get(pname))
+    scope.set(pname, np.full_like(cur, value))
+    return pname
+
+
+def test_save_checkpoint_sweeps_stale_tmp_dirs(tmp_path):
+    from paddle_tpu.distributed.checkpoint import (latest_checkpoint,
+                                                   save_checkpoint)
+    main, exe = _build_with_param(1.0)
+    d = str(tmp_path / "ck")
+    # a crashed previous save left an orphan tmp behind (long ago: the
+    # sweep is age-gated so a CONCURRENT writer's fresh tmp survives)
+    stale = os.path.join(d, "checkpoint_7.tmp")
+    os.makedirs(stale)
+    with open(os.path.join(stale, "junk"), "w") as f:
+        f.write("partial")
+    old = time.time() - 3600
+    os.utime(stale, (old, old))
+    fresh = os.path.join(d, "checkpoint_9.tmp")
+    os.makedirs(fresh)
+    # orphans are invisible to loads...
+    assert latest_checkpoint(d) is None
+    # ...and the next successful save sweeps only the stale one
+    save_checkpoint(d, step=8, main_program=main, executor=exe)
+    names = os.listdir(d)
+    assert "checkpoint_8" in names
+    assert not os.path.exists(stale)
+    assert os.path.exists(fresh)         # possibly another writer's
+    found = latest_checkpoint(d)
+    assert found is not None and found[1]["step"] == 8
+
+
+def test_checkpoint_corruption_matrix(tmp_path):
+    """Truncated payload, md5 mismatch, and missing meta.json are each
+    skipped by load_checkpoint in favor of the next-newest valid
+    checkpoint."""
+    from paddle_tpu.distributed.checkpoint import (latest_checkpoint,
+                                                   load_checkpoint,
+                                                   save_checkpoint)
+    main, exe = _build_with_param(0.0)
+    base = str(tmp_path / "base")
+    pname = None
+    for step in (1, 2, 3):
+        pname = _set_param(main, float(step))
+        save_checkpoint(base, step=step, main_program=main, executor=exe,
+                        max_keep=5)
+
+    def corrupt_truncate(path, meta):
+        payload = os.path.join(path, meta["payload"])
+        with open(payload, "r+b") as f:
+            f.truncate(max(0, os.path.getsize(payload) // 2))
+
+    def corrupt_md5(path, meta):
+        payload = os.path.join(path, meta["payload"])
+        with open(payload, "r+b") as f:
+            f.seek(0)
+            f.write(b"\xff\xfe\xfd\xfc")
+
+    def corrupt_meta(path, meta):
+        os.remove(os.path.join(path, "meta.json"))
+
+    for case in (corrupt_truncate, corrupt_md5, corrupt_meta):
+        d = str(tmp_path / case.__name__)
+        shutil.copytree(base, d)
+        newest = os.path.join(d, "checkpoint_3")
+        with open(os.path.join(newest, "meta.json")) as f:
+            meta = json.load(f)
+        case(newest, meta)
+        found = latest_checkpoint(d)
+        assert found is not None, case.__name__
+        assert found[1]["step"] == 2, case.__name__
+        _set_param(main, -1.0)          # clobber, then restore
+        restored = load_checkpoint(d, main_program=main, executor=exe)
+        assert restored["step"] == 2
+        vals = np.asarray(pt.global_scope().get(pname))
+        np.testing.assert_allclose(vals, 2.0)
+
+
+def test_latest_checkpoint_retry_rides_transient_read_error(tmp_path):
+    """A transient read error on the NEWEST checkpoint must not demote
+    the resume point when a retry policy is given (without one, the
+    scan's corrupt-skip semantics fall back to the next-newest)."""
+    from paddle_tpu.distributed.checkpoint import (latest_checkpoint,
+                                                   save_checkpoint)
+    main, exe = _build_with_param(1.0)
+    d = str(tmp_path / "ck")
+    for step in (1, 2):
+        save_checkpoint(d, step=step, main_program=main, executor=exe)
+
+    with FaultInjector() as fi:
+        fi.on("checkpoint.read", raises=IOError, times=1)
+        found = latest_checkpoint(
+            d, retry=RetryPolicy(max_attempts=2, base_delay_s=0.001,
+                                 jitter=0.0))
+        assert fi.triggered("checkpoint.read") == 1
+    assert found is not None and found[1]["step"] == 2  # NOT demoted
+
+    with FaultInjector() as fi:
+        fi.on("checkpoint.read", raises=IOError, times=1)
+        found = latest_checkpoint(d)                    # no retry
+    assert found is not None and found[1]["step"] == 1  # skipped newest
+
+    # a policy whose DEADLINE expires mid-candidate (RetryError) must
+    # also fall back to the next-newest, not crash the resume scan
+    with FaultInjector() as fi:
+        fi.on("checkpoint.read", raises=IOError, times=1)
+        found = latest_checkpoint(
+            d, retry=RetryPolicy(max_attempts=5, base_delay_s=0.01,
+                                 jitter=0.0, deadline_s=1e-4))
+    assert found is not None and found[1]["step"] == 1
+
+    # structural corruption (missing meta.json) is NOT transient: it
+    # skips immediately instead of burning the retry budget
+    os.remove(os.path.join(d, "checkpoint_2", "meta.json"))
+    resilience.reset_retry_counters()
+    found = latest_checkpoint(
+        d, retry=RetryPolicy(max_attempts=5, base_delay_s=0.01,
+                             jitter=0.0))
+    assert found is not None and found[1]["step"] == 1
+    assert resilience.retry_counters()["checkpoint.read"]["retries"] == 0
+
+
+def test_checkpoint_write_retry_rides_injected_failures(tmp_path):
+    from paddle_tpu.distributed.checkpoint import (latest_checkpoint,
+                                                   save_checkpoint)
+    main, exe = _build_with_param(5.0)
+    d = str(tmp_path / "ck")
+    with FaultInjector() as fi:
+        fi.on("checkpoint.write", raises=IOError, times=2)
+        save_checkpoint(d, step=1, main_program=main, executor=exe,
+                        retry=RetryPolicy(max_attempts=3,
+                                          base_delay_s=0.001, jitter=0.0))
+        assert fi.triggered("checkpoint.write") == 2
+    found = latest_checkpoint(d)
+    assert found is not None and found[1]["step"] == 1
