@@ -1,0 +1,152 @@
+"""A/B: synchronous vs pipelined training loop through the Trainer.
+
+Trains an MNIST-sized MLP against a SYNTHETIC SLOW READER (a fixed
+per-batch host delay standing in for real input assembly: decode,
+augmentation, a slow storage link) in two modes:
+
+  sync       log_every=1, prefetch=0 — the host converts/uploads the
+             batch, dispatches, and blocks on the cost fetch every
+             iteration; feed time and compute time serialize.
+  pipelined  log_every=K, prefetch=2 — a depth-2 FeedPrefetcher
+             converts + uploads batch N+1 while batch N computes, the
+             step is dispatched async (Executor.run sync=False), and
+             cost is materialized every K-th iteration only.
+
+Prints ONE JSON report (same shape conventions as
+benchmarks/serving_latency.py: a flat dict of params + results, ready
+for BENCH_*.json rounds): steps/sec per mode, the speedup, and each
+mode's host-blocked-time fraction — the share of wall time the host
+spent in pipeline::prefetch_wait / pipeline::fetch_sync /
+pipeline::host_blocked profiler spans (CAT_PIPELINE).
+
+    python benchmarks/pipeline_overlap.py --batches 40 --passes 3 \
+        --reader_delay_ms 5 --log_every 8
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BLOCKED_EVENTS = ("pipeline::prefetch_wait", "pipeline::fetch_sync",
+                  "pipeline::host_blocked")
+
+
+def build_mlp(in_dim, hidden, classes):
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    pt.reset_default_programs()
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = 0
+    with pt.program_guard(main, startup):
+        img = layers.data("img", [in_dim])
+        label = layers.data("label", [1], dtype="int64")
+        h = layers.fc(img, size=hidden, act="relu")
+        logits = layers.fc(h, size=classes)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def slow_reader(n_batches, bs, in_dim, classes, delay_s, seed=7):
+    """Deterministic random batches with a fixed host-side delay per
+    batch — the synthetic input-bound reader both modes consume."""
+    def read():
+        rng = np.random.RandomState(seed)
+        for _ in range(n_batches):
+            time.sleep(delay_s)
+            yield {"img": rng.rand(bs, in_dim).astype(np.float32),
+                   "label": rng.randint(0, classes,
+                                        (bs, 1)).astype(np.int64)}
+    return read
+
+
+def run_mode(mode, args):
+    import paddle_tpu as pt
+    from paddle_tpu import profiler
+    from paddle_tpu.trainer import Trainer
+
+    pt.reset_global_scope()
+    main, startup, loss = build_mlp(args.in_dim, args.hidden,
+                                    args.classes)
+    trainer = Trainer(loss, main_program=main, startup_program=startup)
+    trainer.start()
+    kw = dict(log_every=1, prefetch=0) if mode == "sync" else \
+        dict(log_every=args.log_every, prefetch=args.prefetch)
+    reader = slow_reader(args.batches, args.batch_size, args.in_dim,
+                         args.classes, args.reader_delay_ms * 1e-3)
+    # warmup pass: pay trace+XLA compile outside the timed window
+    trainer.train(num_passes=1, reader=slow_reader(
+        2, args.batch_size, args.in_dim, args.classes, 0.0), **kw)
+
+    profiler.start_profiler()
+    t0 = time.monotonic()
+    trainer.train(num_passes=args.passes, reader=reader, **kw)
+    trainer.exe.synchronize()
+    wall = time.monotonic() - t0
+    profiler.stop_profiler()
+    blocked_us = sum(e["dur"] for e in profiler.events()
+                     if e.get("cat") == profiler.CAT_PIPELINE
+                     and e["name"] in BLOCKED_EVENTS)
+
+    steps = args.passes * args.batches
+    return {
+        "steps": steps,
+        "wall_s": round(wall, 4),
+        "steps_per_sec": round(steps / wall, 2),
+        "host_blocked_fraction": round(blocked_us / (wall * 1e6), 4),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batches", type=int, default=40,
+                   help="batches per pass")
+    p.add_argument("--passes", type=int, default=3,
+                   help="timed passes per mode")
+    p.add_argument("--batch_size", type=int, default=64)
+    p.add_argument("--in_dim", type=int, default=784)
+    p.add_argument("--hidden", type=int, default=512)
+    p.add_argument("--classes", type=int, default=10)
+    p.add_argument("--reader_delay_ms", type=float, default=6.0,
+                   help="synthetic per-batch host input delay")
+    p.add_argument("--log_every", type=int, default=8,
+                   help="pipelined mode: materialize cost every K steps")
+    p.add_argument("--prefetch", type=int, default=2,
+                   help="pipelined mode: FeedPrefetcher depth")
+    args = p.parse_args()
+
+    sync = run_mode("sync", args)
+    pipelined = run_mode("pipelined", args)
+    report = {
+        "benchmark": "pipeline_overlap",
+        "batches": args.batches,
+        "passes": args.passes,
+        "batch_size": args.batch_size,
+        "in_dim": args.in_dim,
+        "hidden": args.hidden,
+        "reader_delay_ms": args.reader_delay_ms,
+        "log_every": args.log_every,
+        "prefetch": args.prefetch,
+        "sync": sync,
+        "pipelined": pipelined,
+        "speedup": round(pipelined["steps_per_sec"] /
+                         sync["steps_per_sec"], 3),
+    }
+    print(json.dumps(report, indent=2))
+    return report
+
+
+if __name__ == "__main__":
+    main()

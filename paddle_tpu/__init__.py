@@ -15,7 +15,7 @@ from .framework import (  # noqa
     reset_default_programs,
 )
 from .executor import (Executor, CPUPlace, CUDAPlace,  # noqa
-                       TPUPlace, scope_guard)
+                       TPUPlace, StepResult, scope_guard)
 from .layer_helper import (LayerHelper, ParamAttr,  # noqa
                            WeightNormParamAttr)
 from . import layers  # noqa

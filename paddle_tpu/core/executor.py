@@ -26,12 +26,22 @@ import jax
 import jax.numpy as jnp
 
 from ..amp import amp_enabled
+from .. import profiler
 from .ir import Program, BlockDesc, OpDesc
 from .lod import LoDTensor, RaggedNested, RaggedPair, RaggedTree
 from .registry import OpRegistry, run_op
 from .scope import Scope, global_scope
 
 STEP_VAR = "@step_counter@"
+
+# Donate the read-write persistable state (params + optimizer
+# accumulators) to the jitted step so XLA aliases state-in to state-out
+# instead of allocating a fresh output buffer per step. On by default;
+# PADDLE_TPU_DONATE_STATE=0 (or Executor(donate_state=False)) restores
+# copy-per-step for callers that hold references to scope state across
+# runs. Part of the compile-cache key: flipping it recompiles.
+DONATE_STATE_DEFAULT = \
+    os.environ.get("PADDLE_TPU_DONATE_STATE", "1") != "0"
 
 # Parity with the reference's FLAGS_check_nan_inf (executor.cc:27,345-353).
 CHECK_NAN_INF = os.environ.get("PADDLE_TPU_CHECK_NAN_INF", "0") == "1"
@@ -142,6 +152,13 @@ def _to_device_value(value):
     return _maybe_cached(value)
 
 
+def device_feed(feed: Dict[str, Any]) -> Dict[str, Any]:
+    """Upload a host feed dict to in-graph device form (idempotent:
+    already-device values pass through). The shared convert+upload step
+    behind DataFeeder.feed_device and the Trainer's feed prefetch."""
+    return {k: _to_device_value(v) for k, v in feed.items()}
+
+
 def _np_fetch(x) -> np.ndarray:
     """Device -> numpy, widening bf16 to f32 at the fetch boundary: under
     AMP activations live on device at half width, but numpy has no native
@@ -188,6 +205,72 @@ def feed_signature(feed_vals) -> Tuple:
     numpy/ndarray-likes with .shape/.dtype also work. Serving uses this
     to predict whether a padded batch will reuse an existing executable."""
     return tuple(sorted((k, _abstractify(v)) for k, v in feed_vals.items()))
+
+
+class StepResult:
+    """Undelivered fetches of an async `Executor.run(..., sync=False)`.
+
+    Holds the dispatched step's device values; nothing blocks until a
+    fetched value is consumed. `fetches()` (and indexing/iteration)
+    materializes host values once, under a `pipeline::fetch_sync`
+    profiler event, then drops the device references so the buffers are
+    not pinned for the result's lifetime. `block_until_ready()` waits
+    for the computation without converting. XLA async errors (and the
+    NaN/Inf check, when enabled) surface at materialization, not at
+    dispatch."""
+
+    def __init__(self, raw_fetches, fetch_names, return_numpy: bool,
+                 nan_check: bool = False):
+        self._raw = list(raw_fetches)
+        self.fetch_names = list(fetch_names)
+        self._return_numpy = return_numpy
+        self._nan_check = nan_check
+        self._values: Optional[List[Any]] = None
+
+    @property
+    def ready(self) -> bool:
+        """True once the dispatched step has finished on device (always
+        True after materialization)."""
+        if self._values is not None:
+            return True
+        return all(leaf.is_ready() for leaf
+                   in jax.tree_util.tree_leaves(self._raw)
+                   if hasattr(leaf, "is_ready"))
+
+    def block_until_ready(self) -> "StepResult":
+        """Wait for the device computation; does NOT convert to host."""
+        if self._values is None:
+            for leaf in jax.tree_util.tree_leaves(self._raw):
+                if hasattr(leaf, "block_until_ready"):
+                    leaf.block_until_ready()
+        return self
+
+    def fetches(self) -> List[Any]:
+        """Materialized fetch values (cached after the first call)."""
+        if self._values is None:
+            with profiler.RecordEvent("pipeline::fetch_sync",
+                                      cat=profiler.CAT_PIPELINE):
+                vals = [_to_host_value(v, self._return_numpy)
+                        for v in self._raw]
+            if self._nan_check:
+                for n, v in zip(self.fetch_names, vals):
+                    arr = v.data if isinstance(v, LoDTensor) else v
+                    if np.issubdtype(np.asarray(arr).dtype, np.floating) \
+                            and not np.isfinite(arr).all():
+                        raise FloatingPointError(
+                            f"NaN/Inf detected in fetched var {n!r}")
+            self._values = vals
+            self._raw = []  # release device references
+        return list(self._values)
+
+    def __len__(self):
+        return len(self.fetch_names)
+
+    def __getitem__(self, i):
+        return self.fetches()[i]
+
+    def __iter__(self):
+        return iter(self.fetches())
 
 
 def trace_block(block: BlockDesc, env: Dict[str, Any],
@@ -385,8 +468,15 @@ class Executor:
     """Runs Programs. `place` is accepted for API parity; JAX device
     selection is global (TPU if present, else CPU)."""
 
-    def __init__(self, place=None):
+    def __init__(self, place=None, donate_state: Optional[bool] = None):
         self.place = place
+        # donate_state=None reads PADDLE_TPU_DONATE_STATE (default on).
+        self.donate_state = DONATE_STATE_DEFAULT if donate_state is None \
+            else bool(donate_state)
+        # state arrays written by the most recent run(): the sync
+        # barrier set for synchronize() (checkpoint snapshots must not
+        # race an in-flight async step)
+        self._inflight_state: List[Any] = []
         self._cache: Dict[Tuple, CompiledProgram] = {}
         self._probe_cache: Dict[Tuple, Any] = {}
         # stateful-op scan results for run(iterations=K), keyed by
@@ -407,20 +497,25 @@ class Executor:
     @staticmethod
     def compile_key(program, feed_sig, fetch_names, block_idx: int = 0,
                     while_bounds=None, iterations: int = 1,
-                    stacked_feed: bool = False, amp=None) -> Tuple:
+                    stacked_feed: bool = False, amp=None,
+                    donate=None) -> Tuple:
         """The compile-cache key for one (program, feed signature, fetch
         list) combination — the public form of the private cache tuple,
         so callers (serving warmup, cache probes) can reason about
         executable reuse without duplicating the key layout. `feed_sig`
         comes from `feed_signature`; `amp=None` reads the ambient AMP
-        state, matching what run() would use."""
+        state, matching what run() would use; `donate=None` reads the
+        process default (donation aliases state-in to state-out, a
+        different executable than the copy-per-step build, so it is
+        part of the key)."""
         if hasattr(program, "desc"):
             program = program.desc
         return (program.uid, program.version, feed_sig,
                 tuple(fetch_names), block_idx,
                 amp_enabled() if amp is None else bool(amp),
                 tuple(sorted(while_bounds.items())) if while_bounds
-                else None, iterations, stacked_feed)
+                else None, iterations, stacked_feed,
+                DONATE_STATE_DEFAULT if donate is None else bool(donate))
 
     # ------------------------------------------------------------------
     def _probe_while_bounds(self, program: Program, block: BlockDesc,
@@ -480,7 +575,8 @@ class Executor:
                  scope: Scope,
                  while_bounds=None, iterations: int = 1,
                  or_reduce_tail: int = 0,
-                 stacked_feed: bool = False) -> CompiledProgram:
+                 stacked_feed: bool = False,
+                 donate: bool = True) -> CompiledProgram:
         read_names, write_names = _collect_state_names(program, block, scope)
         fetch_names = list(fetch_names)
         # Donate only buffers that are overwritten (param updates); read-only
@@ -568,7 +664,13 @@ class Executor:
                 new_state.update(extra_w)
                 return fetches, new_state
 
-        jitted = jax.jit(fn, donate_argnums=(2,))
+        # donate=True aliases the rw state (argnum 2) in XLA: state-out
+        # writes land in the state-in buffers instead of fresh
+        # allocations, removing the per-step state-copy traffic. The
+        # caller-side contract — the scope-held input arrays are DEAD
+        # after the call — is enforced in run() (scope is repointed at
+        # the outputs, and stragglers are erased).
+        jitted = jax.jit(fn, donate_argnums=(2,) if donate else ())
 
         def call(feed_vals, state_vals, step):
             ro = {n: state_vals[n] for n in ro_names}
@@ -583,11 +685,21 @@ class Executor:
     def run(self, program: Program, feed: Optional[Dict[str, Any]] = None,
             fetch_list: Optional[Sequence] = None, scope: Optional[Scope] = None,
             return_numpy: bool = True, block_idx: int = 0,
-            iterations: int = 1, stacked_feed: bool = False):
+            iterations: int = 1, stacked_feed: bool = False,
+            sync: bool = True):
         """Execute `program` block `block_idx` with `feed`, return fetches.
 
         feed values: numpy arrays, python scalars, or LoDTensor for ragged.
         fetch_list entries: var names or objects with a `.name`.
+
+        sync=False returns a `StepResult` instead of materialized
+        fetches: the step is dispatched (and persistable state in the
+        scope already points at the new device arrays), but
+        device->host transfer happens only when a fetched value is
+        consumed, so the host can feed/dispatch the NEXT step while
+        this one computes. With state donation on, fetching an rw
+        (donated) state var asynchronously is rejected — the lazy
+        handle would alias a buffer the next step donates.
 
         iterations > 1 runs the block that many times inside ONE compiled
         program (a lax.scan over the traced step, state chained through
@@ -685,7 +797,8 @@ class Executor:
         key = self.compile_key(program, feed_sig, fetch_names, block_idx,
                                while_bounds=while_bounds,
                                iterations=iterations,
-                               stacked_feed=stacked_feed)
+                               stacked_feed=stacked_feed,
+                               donate=self.donate_state)
         compiled = self._cache.get(key)
         if compiled is None:
             self.cache_stats["misses"] += 1
@@ -695,50 +808,90 @@ class Executor:
                 "stacked_feed": stacked_feed}
             compiled = self._compile(program, block, feed_sig, fetch_names,
                                      scope, while_bounds=while_bounds,
-                                     **kw)
+                                     donate=self.donate_state, **kw)
             self._cache[key] = compiled
         else:
             self.cache_stats["hits"] += 1
+
+        if not sync and self.donate_state:
+            rw = set(compiled.rw_names)
+            aliased = [n for n in fetch_names[:n_user_fetches] if n in rw]
+            if aliased:
+                raise ValueError(
+                    f"sync=False cannot fetch donated state vars "
+                    f"{aliased}: the lazy StepResult would hold a buffer "
+                    "the next step donates (and XLA deletes). Fetch them "
+                    "with sync=True, or build the Executor with "
+                    "donate_state=False.")
 
         state_vals = {n: scope.get(n) for n in compiled.read_names}
         # kept for AOT introspection (profiler cost analysis, the
         # collective audit's HLO re-lowering)
         self._last_feed_vals = feed_vals
-        fetches, new_state = compiled.fn(feed_vals, state_vals, step)
+        with profiler.RecordEvent("pipeline::dispatch",
+                                  cat=profiler.CAT_PIPELINE):
+            fetches, new_state = compiled.fn(feed_vals, state_vals, step)
         scope.set(STEP_VAR, step + iterations)
         for n, v in new_state.items():
             scope.set(n, v)
+        if self.donate_state:
+            # every donated input buffer is dead after the call; the
+            # loop above repointed scope at the outputs for vars the
+            # trace produced — explicitly drop any donated name the
+            # trace did NOT write back, so a later scope read fails
+            # loudly (KeyError) instead of returning a deleted buffer
+            for n in compiled.rw_names:
+                if n not in new_state:
+                    scope.erase(n)
+        self._inflight_state = list(new_state.values())
 
         flag_vals = list(zip(fetch_names[n_user_fetches:],
                              fetches[n_user_fetches:]))
-        results = [_to_host_value(v, return_numpy)
-                   for v in fetches[:n_user_fetches]]
         if CHECK_WHILE_BOUND:
             # enforced mode reads the flags synchronously so the raise
             # points at the offending step
             for n, v in flag_vals:
                 _check_while_flag((program.uid, n), v, raise_=True)
         else:
-            # warn mode: check the previous step's flags (long since
-            # computed — reading them does not stall this step) and
-            # defer this step's to the next call / close()
+            # warn mode: consume deferred flags whose arrays are
+            # already resident — reading those is free — and KEEP
+            # deferring any still in flight, so back-to-back async
+            # dispatches are never capped by the check (a pipelined
+            # loop drains them one-to-two steps late; close()/atexit
+            # flushes stragglers with a sync)
+            still = []
             for fkey, v in self._deferred_flags:
-                _check_while_flag(fkey, v, raise_=False)
-            self._deferred_flags = [((program.uid, n), v)
-                                    for n, v in flag_vals]
-        if CHECK_NAN_INF:
-            for n, v in zip(fetch_names, results):
-                arr = v.data if isinstance(v, LoDTensor) else v
-                if np.issubdtype(np.asarray(arr).dtype, np.floating) and \
-                        not np.isfinite(arr).all():
-                    raise FloatingPointError(
-                        f"NaN/Inf detected in fetched var {n!r}")
-        return results
+                if getattr(v, "is_ready", lambda: True)():
+                    _check_while_flag(fkey, v, raise_=False)
+                else:
+                    still.append((fkey, v))
+            still.extend(((program.uid, n), v) for n, v in flag_vals)
+            self._deferred_flags = still
+        result = StepResult(fetches[:n_user_fetches],
+                            fetch_names[:n_user_fetches], return_numpy,
+                            nan_check=CHECK_NAN_INF)
+        return result.fetches() if sync else result
+
+    def synchronize(self):
+        """Barrier: block until every state write dispatched by this
+        executor is resident on device. Checkpoint saves during async
+        training call this before snapshotting persistable state, so a
+        snapshot can never race the in-flight step (and an async XLA
+        error surfaces here, at a named point, instead of inside the
+        tmp-write)."""
+        with profiler.RecordEvent("pipeline::host_blocked",
+                                  cat=profiler.CAT_PIPELINE):
+            for leaf in jax.tree_util.tree_leaves(self._inflight_state):
+                if hasattr(leaf, "block_until_ready"):
+                    leaf.block_until_ready()
+            self._inflight_state = []
+        return self
 
     def close(self):
         for key, v in self._deferred_flags:
             _check_while_flag(key, v, raise_=False)
         self._deferred_flags = []
+        self._inflight_state = []
         self._cache.clear()
         self._probe_cache.clear()
         self._stateful_cache.clear()

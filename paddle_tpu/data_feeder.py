@@ -61,6 +61,16 @@ class DataFeeder:
                 out[name] = arr
         return out
 
+    def feed_device(self, batch: Sequence[Sequence]) -> Dict[str, object]:
+        """`feed()` plus host->device upload: every value is converted to
+        its in-graph device form (`core.executor._to_device_value`, so
+        frozen owning arrays still route through the device-side feed
+        cache). This is the form the FeedPrefetcher parks — uploading
+        batch N+1 while batch N computes — and `Executor.run` accepts it
+        unchanged (device conversion is idempotent)."""
+        from .core.executor import device_feed
+        return device_feed(self.feed(batch))
+
     @staticmethod
     def _feat_dims(var):
         if not isinstance(var, str) and var.shape:

@@ -71,6 +71,13 @@ def save_checkpoint(dirname: str, step: int, main_program=None,
     from ..framework import default_main_program
 
     program = main_program or default_main_program()
+    # sync barrier: under async dispatch (Executor.run sync=False) the
+    # scope's persistable arrays may still be in flight; snapshotting
+    # must wait for the dispatched step so the checkpoint can never
+    # tear across it, and an async step error surfaces here instead of
+    # mid-write
+    if executor is not None and hasattr(executor, "synchronize"):
+        executor.synchronize()
     os.makedirs(dirname, exist_ok=True)
     final = os.path.join(dirname, f"checkpoint_{step}")
     tmp = final + ".tmp"

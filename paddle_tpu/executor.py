@@ -4,6 +4,7 @@ the default when TPU devices exist, CPUPlace forces the host backend."""
 from __future__ import annotations
 
 from .core.executor import Executor as _CoreExecutor
+from .core.executor import StepResult  # noqa: F401 — public re-export
 
 
 class CPUPlace:

@@ -22,6 +22,11 @@ FLAGS: Dict[str, tuple] = {
         "0", "core/executor.py",
         "scan fetched values for NaN/Inf after each run (reference "
         "FLAGS_check_nan_inf)"),
+    "PADDLE_TPU_DONATE_STATE": (
+        "1", "core/executor.py",
+        "donate rw persistable state to the jitted step (XLA aliases "
+        "state-in to state-out in place of a copy per step); 0 restores "
+        "copy-per-step for callers holding scope state across runs"),
     "PADDLE_TPU_CONV_LAYOUT": (
         "nchw", "ops/nn_ops.py",
         "conv internal layout A/B knob ('nhwc' transposes at conv "

@@ -129,7 +129,7 @@ class ParallelExecutor(Executor):
 
     def _compile(self, program, block, feed_sig, fetch_names, scope,
                  while_bounds=None, iterations: int = 1,
-                 or_reduce_tail: int = 0):
+                 or_reduce_tail: int = 0, donate: bool = True):
         if iterations != 1:
             raise NotImplementedError(
                 "ParallelExecutor does not support run(iterations=K) yet "
@@ -261,7 +261,7 @@ class ParallelExecutor(Executor):
             in_shardings=(feed_shardings, ro_shardings, rw_shardings,
                           NamedSharding(mesh, P())),
             out_shardings=(fetch_out, state_out),
-            donate_argnums=(2,))
+            donate_argnums=(2,) if donate else ())
 
         multiprocess = self._multiprocess
         step_sh = NamedSharding(mesh, P())

@@ -23,6 +23,16 @@ CAT_SERVING = "serving"
 # Retry/backoff spans from paddle_tpu.resilience.retry: each retry::<op>
 # event covers the backoff sleep before that retry attempt.
 CAT_RESILIENCE = "resilience"
+# Host/device pipelining spans (core/executor.py + trainer.py + reader
+# FeedPrefetcher). The four event names partition a training step's
+# host-side time so an A/B trace shows exactly where the host stalls:
+#   pipeline::dispatch      - enqueueing the jitted step (async, cheap)
+#   pipeline::fetch_sync    - materializing fetched values to host
+#   pipeline::prefetch_wait - consumer waiting on the feed prefetcher
+#   pipeline::host_blocked  - explicit sync barriers (checkpoint snapshot,
+#                             Executor.synchronize) and inline
+#                             (un-prefetched) reader+feed assembly
+CAT_PIPELINE = "pipeline"
 
 
 class RecordEvent:

@@ -23,7 +23,7 @@ import numpy as np
 __all__ = [
     "map_readers", "shuffle", "chain", "compose", "buffered", "firstn",
     "xmap_readers", "batch", "double_buffer", "cache", "ComposeNotAligned",
-    "multiprocess_batch_reader",
+    "multiprocess_batch_reader", "FeedPrefetcher",
 ]
 
 from .multiprocess import multiprocess_batch_reader  # noqa: E402
@@ -241,6 +241,143 @@ def double_buffer(reader, size: int = 2):
     """Prefetch decorated batches on a background thread so host input
     assembly overlaps device compute."""
     return buffered(reader, size)
+
+
+class FeedPrefetcher:
+    """Double-buffered feed pipeline for the Trainer's event loop.
+
+    A bounded background thread pulls batches from `batch_iter`, runs
+    `convert` on each (feed-dict assembly + host->device upload — the
+    expensive host half of a training step) and parks up to `depth`
+    (default 2) converted feeds, so batch N+1's feed work overlaps
+    batch N's device compute. The consumer side is a plain iterator.
+
+    Contract:
+      * fires the `reader.next` fault point once per PULLED batch, in
+        the producer thread, so chaos tests can stall or kill the input
+        pipeline through the prefetcher (resilience/faults.py). NOTE:
+        wrapping a `reader.batch()` reader (which fires the same point
+        per YIELDED batch) doubles the point's call rate — arm
+        schedules accordingly, or pass fire_faults=False here to keep
+        batch()'s firing the only one;
+      * any producer-side exception — from the reader, from `convert`,
+        or injected — re-raises in the consumer on the next pull, after
+        which the prefetcher is closed;
+      * `close()` is idempotent, unblocks a producer stuck on the full
+        queue, and joins the thread (clean shutdown — tests assert no
+        `feed-prefetcher-*` thread outlives its loop);
+      * consumer waits are recorded as `pipeline::prefetch_wait`
+        profiler events (CAT_PIPELINE): with a fast-enough reader the
+        wait is ~0 and the input pipeline is off the critical path.
+    """
+
+    _END = object()
+    _ids = itertools.count()
+
+    def __init__(self, batch_iter, convert: Callable = None,
+                 depth: int = 2, fire_faults: bool = True):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._it = iter(batch_iter)
+        self._convert = convert if convert is not None else (lambda b: b)
+        self._fire_faults = bool(fire_faults)
+        # bound HERE (consumer thread): an import failure raises at
+        # construction instead of killing the producer thread before
+        # its try block, which would leave the consumer blocked forever
+        from ..resilience import faults
+        self._faults = faults
+        self._q: _queue.Queue = _queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._done = False
+        self._thread = threading.Thread(
+            target=self._fill, name=f"feed-prefetcher-{next(self._ids)}",
+            daemon=True)
+        self._thread.start()
+
+    # -- producer ------------------------------------------------------
+    def _fill(self):
+        try:
+            while not self._stop.is_set():
+                try:
+                    raw = next(self._it)
+                except StopIteration:
+                    self._put(self._END)
+                    return
+                if self._fire_faults:
+                    self._faults.fire("reader.next")
+                if not self._put(("feed", self._convert(raw))):
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+            self._put(("err", e))
+
+    def _put(self, item) -> bool:
+        """Bounded put that stays responsive to close(): never blocks
+        longer than the poll interval while the queue is full."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    # -- consumer ------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from .. import profiler
+        if self._done:
+            raise StopIteration
+        with profiler.RecordEvent("pipeline::prefetch_wait",
+                                  cat=profiler.CAT_PIPELINE):
+            item = self._q.get()
+        # re-check _done AFTER waking: a cross-thread close() may have
+        # raced a final producer put into the drained queue — a feed
+        # item received after close is DISCARDED (close's contract),
+        # not delivered
+        if item is self._END or self._done:
+            self._done = True
+            self.close()
+            raise StopIteration
+        kind, payload = item
+        if kind == "err":
+            self._done = True
+            self.close()
+            raise payload
+        return payload
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self, timeout: float = 5.0):
+        """Stop the producer and join its thread. Safe to call twice;
+        pending prefetched feeds are discarded."""
+        self._done = True
+        self._stop.set()
+        # drain so a producer blocked on a full queue observes stop at
+        # its next put poll
+        try:
+            while True:
+                self._q.get_nowait()
+        except _queue.Empty:
+            pass
+        # wake a consumer blocked in __next__'s untimed get() (close()
+        # may come from another thread — a watchdog, a test teardown):
+        # after the drain there is space for the sentinel, but a racing
+        # producer put makes Full possible; either way the consumer
+        # wakes, and its post-wake _done check discards a raced-in feed
+        # item instead of delivering it
+        try:
+            self._q.put_nowait(self._END)
+        except _queue.Full:
+            pass
+        self._thread.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
 
 def device_prefetch(reader, size: int = 2):

@@ -13,7 +13,13 @@ Registered fault points (armed sites, see each caller):
     master.rpc          distributed/master.py MasterClient per-RPC attempt
     pserver.push        distributed/pserver.py PServerClient push attempt
     serving.batch       serving/engine.py per-batch model run
-    reader.next         reader/__init__.py batch() per yielded batch
+    reader.next         reader/__init__.py batch() per yielded batch,
+                        and FeedPrefetcher per pulled batch (its
+                        producer thread — faults propagate to the
+                        consuming training loop). Composing BOTH
+                        doubles the call rate; arm schedules
+                        accordingly or build the prefetcher with
+                        fire_faults=False
     dataset.download    dataset/common.py download fetch attempt
 
 Design: `fire(point)` is on hot paths (per batch, per RPC), so the

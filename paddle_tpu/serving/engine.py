@@ -34,7 +34,8 @@ class ServingEngine:
     def __init__(self, model, config: Optional[BatchingConfig] = None,
                  metrics: Optional[ServingMetrics] = None,
                  num_workers: int = 1,
-                 health: Optional[HealthMonitor] = None):
+                 health: Optional[HealthMonitor] = None,
+                 async_dispatch: bool = False):
         self.model = model
         self.config = config or BatchingConfig()
         self.metrics = metrics or ServingMetrics()
@@ -45,6 +46,16 @@ class ServingEngine:
         self.batcher = DynamicBatcher(model.feed_specs, self.config,
                                       self.metrics)
         self.num_workers = int(num_workers)
+        # opt-in host/device pipelining BETWEEN bucket flushes: each
+        # worker dispatches batch N (Executor.run sync=False), then —
+        # while the device computes it — dequeues/pads batch N+1 and
+        # dispatches that before delivering N's results. One batch per
+        # worker stays undelivered at a time, so latency grows by at
+        # most one batch while the device never waits for result
+        # delivery. Off by default: the sync loop is simpler to reason
+        # about under faults and is the latency-optimal choice at low
+        # load.
+        self.async_dispatch = bool(async_dispatch)
         # per-row vs batch-level fetch split decided from the STATIC
         # fetch specs (leading -1 = batched): a runtime shape check
         # alone would misclassify a batch-level fetch whose leading dim
@@ -159,6 +170,7 @@ class ServingEngine:
         out["seq_buckets"] = (list(self.config.seq_buckets)
                               if self.config.seq_buckets else None)
         out["workers"] = len(self._threads)
+        out["async_dispatch"] = self.async_dispatch
         out["started"] = self._started
         out["stopped"] = self._stopped
         out["health"] = self.health.snapshot()
@@ -168,11 +180,45 @@ class ServingEngine:
 
     # -- worker ------------------------------------------------------------
     def _worker_loop(self):
+        if not self.async_dispatch:
+            while True:
+                batch = self.batcher.next_batch()
+                if batch is None:
+                    return
+                self._run_batch(batch)
+        # pipelined loop: one undelivered (batch, StepResult) in flight
+        # per worker; the NEXT batch is dequeued and dispatched before
+        # the previous one's results are materialized and delivered.
+        # With a result in flight the dequeue must not sit on it: poll
+        # (timeout=0) and, if nothing is flushable RIGHT NOW, deliver
+        # the pending result instead of parking it behind the batcher's
+        # latency deadline — low traffic degrades to the sync loop, the
+        # overlap only engages under sustained load.
+        pending = None
         while True:
-            batch = self.batcher.next_batch()
-            if batch is None:
-                return
-            self._run_batch(batch)
+            if pending is not None:
+                batch = self.batcher.next_batch(timeout=0.0)
+                if batch is None:
+                    self._deliver(*pending)
+                    pending = None
+                    continue
+            else:
+                batch = self.batcher.next_batch()
+                if batch is None:  # closed and fully drained
+                    return
+            t0 = time.monotonic()
+            try:
+                with profiler.RecordEvent(
+                        f"serving::batch_dispatch[{batch.bucket_rows}]",
+                        cat=profiler.CAT_SERVING):
+                    faults.fire("serving.batch")
+                    res = self.model.run_direct(batch.feed, sync=False)
+            except BaseException as e:  # dispatch failed; keep serving
+                self._fail_batch(batch, e)
+                res = None
+            if pending is not None:
+                self._deliver(*pending)
+            pending = (batch, res, t0) if res is not None else None
 
     def _run_batch(self, batch: Batch):
         t0 = time.monotonic()
@@ -183,11 +229,27 @@ class ServingEngine:
                 faults.fire("serving.batch")
                 fetches = self.model.run_direct(batch.feed)
         except BaseException as e:  # deliver failures, keep serving
-            self.metrics.errors.inc(len(batch.requests))
-            self.health.record_failure(e)
-            for req in batch.requests:
-                req.future.set_exception(e)
+            self._fail_batch(batch, e)
             return
+        self._complete(batch, fetches, t0)
+
+    def _deliver(self, batch: Batch, res, t0: float):
+        """Materialize an async-dispatched batch's StepResult and hand
+        each request its rows. XLA async errors surface here."""
+        try:
+            fetches = res.fetches()
+        except BaseException as e:
+            self._fail_batch(batch, e)
+            return
+        self._complete(batch, fetches, t0)
+
+    def _fail_batch(self, batch: Batch, e: BaseException):
+        self.metrics.errors.inc(len(batch.requests))
+        self.health.record_failure(e)
+        for req in batch.requests:
+            req.future.set_exception(e)
+
+    def _complete(self, batch: Batch, fetches, t0: float):
         t1 = time.monotonic()
         self.health.record_success()
         for req, (i0, i1) in zip(batch.requests, batch.slices):

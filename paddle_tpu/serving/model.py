@@ -74,15 +74,19 @@ class ServableModel:
                 "save_inference_model (which prunes the training graph)")
 
     # ------------------------------------------------------------------
-    def run_direct(self, feed: Dict[str, Any]):
-        """One synchronous Executor.run against the pinned weights,
-        bypassing the batcher. The engine's batch path and warmup both
-        land here, so a request served through the engine is bit-identical
-        to a direct run with the same padded batch."""
+    def run_direct(self, feed: Dict[str, Any], sync: bool = True):
+        """One Executor.run against the pinned weights, bypassing the
+        batcher. The engine's batch path and warmup both land here, so a
+        request served through the engine is bit-identical to a direct
+        run with the same padded batch. sync=False dispatches and
+        returns a lazy StepResult (a frozen program writes no
+        persistable state, so nothing is donated and the handle never
+        aliases a to-be-deleted buffer); only dispatch needs the run
+        lock — materialization happens outside it."""
         with self._run_lock:
             return self.executor.run(self.program, feed=feed,
                                      fetch_list=self.fetch_names,
-                                     scope=self.scope)
+                                     scope=self.scope, sync=sync)
 
     def predict(self, feed: Dict[str, Any],
                 timeout: Optional[float] = None):
@@ -92,8 +96,10 @@ class ServableModel:
             return self._engine.predict(feed, timeout=timeout)
         return self.run_direct(feed)
 
-    def serve(self, config=None, metrics=None, num_workers: int = 1):
+    def serve(self, config=None, metrics=None, num_workers: int = 1,
+              async_dispatch: bool = False):
         """Create (but do not start) a ServingEngine bound to this model."""
         from .engine import ServingEngine
         return ServingEngine(self, config=config, metrics=metrics,
-                             num_workers=num_workers)
+                             num_workers=num_workers,
+                             async_dispatch=async_dispatch)

@@ -10,6 +10,7 @@ here on the host."""
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
@@ -33,11 +34,39 @@ class BeginIteration:
 
 
 class EndIteration:
-    def __init__(self, pass_id, batch_id, cost, metrics=None):
+    """End-of-iteration event. `cost`/`metrics` may be LAZY: when the
+    Trainer dispatched the step asynchronously it hands the event a
+    StepResult instead of materialized values, and reading `.cost` (or
+    `.metrics`) forces the device fetch at that point. A handler that
+    skips them on non-logged iterations keeps the pipeline unblocked; a
+    handler that always reads them gets the synchronous behaviour,
+    values bit-identical either way."""
+
+    def __init__(self, pass_id, batch_id, cost=None, metrics=None,
+                 result=None, metric_names=()):
         self.pass_id = pass_id
         self.batch_id = batch_id
-        self.cost = cost
-        self.metrics = metrics or {}
+        self._cost = cost
+        self._metrics = dict(metrics) if metrics is not None else None
+        self._result = result
+        self._metric_names = tuple(metric_names)
+
+    @property
+    def cost(self):
+        if self._cost is None and self._result is not None:
+            self._cost = _scalar_cost(self._result)
+        return self._cost
+
+    @property
+    def metrics(self):
+        if self._metrics is None:
+            if self._result is not None:
+                outs = self._result.fetches()
+                self._metrics = {k: _dense(v) for k, v in
+                                 zip(self._metric_names, outs[1:])}
+            else:
+                self._metrics = {}
+        return self._metrics
 
 
 class CheckpointConfig:
@@ -120,10 +149,17 @@ class Trainer:
                 "reader yielded a tuple batch but no feed_order was given")
         return self._feeder.feed(batch)
 
+    def _to_feed_device(self, batch):
+        """_to_feed + host->device upload; runs on the prefetcher thread
+        so the transfer overlaps the in-flight step's compute."""
+        from .core.executor import device_feed
+        return device_feed(self._to_feed(batch))
+
     # -- training loop ----------------------------------------------------
     def train(self, num_passes: int, reader: Callable[[], Iterable],
               event_handler: Optional[Callable] = None,
-              steps_per_dispatch: int = 1):
+              steps_per_dispatch: int = 1, log_every: int = 1,
+              prefetch: int = 0):
         """Event-loop training. steps_per_dispatch > 1 consumes K
         DISTINCT reader batches per compiled dispatch: the feeds are
         stacked along a leading K axis and Executor.run(iterations=K,
@@ -134,7 +170,25 @@ class Trainer:
         advances by the number of batches consumed. A short tail
         (fewer than K batches left in the pass) runs one batch at a
         time. Requires dense ndarray feeds of a fixed batch shape —
-        ragged feeds fall back to per-batch dispatches."""
+        ragged feeds fall back to per-batch dispatches.
+
+        Every step is dispatched asynchronously (Executor.run
+        sync=False); `log_every` sets how often the Trainer itself
+        materializes cost/metrics. On logged dispatches (every
+        `log_every`-th, default every one — the synchronous behaviour)
+        EndIteration carries concrete values; in between it carries a
+        lazy StepResult handle, the host never blocks on the device,
+        and up to `log_every` undelivered results stay in flight.
+        Trained weights are bit-identical for any `log_every` — only
+        WHERE the host waits changes. `prefetch` > 0 additionally runs
+        feed conversion + device upload for batch N+1 on a bounded
+        background FeedPrefetcher (depth `prefetch`, 2 = classic
+        double buffering) while batch N computes; incompatible with
+        steps_per_dispatch > 1 (stacking needs host-side arrays).
+
+        Checkpoint saves insert a device sync barrier first
+        (Executor.synchronize), so a snapshot can never tear across an
+        in-flight step."""
         if not self._started:
             self.start()
         handler = event_handler or (lambda e: None)
@@ -146,6 +200,18 @@ class Trainer:
             raise ValueError(
                 f"steps_per_dispatch must be >= 1, got {k} — a zero "
                 "dispatch would report cost 0.0 while training nothing")
+        log_every = int(log_every)
+        if log_every < 1:
+            raise ValueError(
+                f"log_every must be >= 1, got {log_every}")
+        prefetch = int(prefetch)
+        if prefetch < 0:
+            raise ValueError(f"prefetch must be >= 0, got {prefetch}")
+        if prefetch and k > 1:
+            raise ValueError(
+                "prefetch and steps_per_dispatch > 1 are mutually "
+                "exclusive: stacking K batches needs host-side ndarray "
+                "feeds, but the prefetcher uploads each batch to device")
 
         def _stackable(feeds):
             if len(feeds) < 2:
@@ -166,61 +232,131 @@ class Trainer:
         for pass_id in range(num_passes):
             handler(BeginPass(pass_id))
             costs = []
+            # undelivered StepResults, oldest first; bounded at
+            # log_every so a huge pass can't pin one fetch buffer per
+            # step
+            pending = deque()
+
+            def _drain(keep: int):
+                while len(pending) > keep:
+                    costs.append(_scalar_cost(pending.popleft()))
+
             dispatch_id = 0
-            it = iter(reader())
-            while True:
-                group = []
-                for _ in range(k):
-                    try:
-                        feed = self._to_feed(next(it))
-                        if k > 1:
-                            # accumulating K batches: snapshot ndarray
-                            # feeds NOW — readers like
-                            # multiprocess_batch_reader hand out
-                            # shared-memory views the producer reuses
-                            # once the consumer advances
-                            feed = {n: (np.array(v) if
-                                        isinstance(v, np.ndarray)
-                                        else v)
-                                    for n, v in feed.items()}
-                        group.append(feed)
-                    except StopIteration:
+            prefetcher = None
+            if prefetch:
+                from .reader import FeedPrefetcher
+                prefetcher = FeedPrefetcher(iter(reader()),
+                                            convert=self._to_feed_device,
+                                            depth=prefetch)
+                feed_iter = iter(prefetcher)
+            else:
+                from . import profiler
+
+                def _inline_feeds():
+                    # un-prefetched path: reader + conversion run inline
+                    # on the loop thread, so the wait is HOST-BLOCKED
+                    # time (the A/B benchmark's sync-mode baseline)
+                    raw_it = iter(reader())
+                    while True:
+                        with profiler.RecordEvent(
+                                "pipeline::host_blocked",
+                                cat=profiler.CAT_PIPELINE):
+                            try:
+                                batch = self._to_feed(next(raw_it))
+                            except StopIteration:
+                                return
+                        yield batch
+
+                feed_iter = _inline_feeds()
+            try:
+                while True:
+                    group = []
+                    for _ in range(k):
+                        try:
+                            feed = next(feed_iter)
+                            if k > 1:
+                                # accumulating K batches: snapshot
+                                # ndarray feeds NOW — readers like
+                                # multiprocess_batch_reader hand out
+                                # shared-memory views the producer
+                                # reuses once the consumer advances
+                                feed = {n: (np.array(v) if
+                                            isinstance(v, np.ndarray)
+                                            else v)
+                                        for n, v in feed.items()}
+                            group.append(feed)
+                        except StopIteration:
+                            break
+                    if not group:
                         break
-                if not group:
-                    break
-                handler(BeginIteration(pass_id, dispatch_id))
-                stacked = _stackable(group) if len(group) == k and \
-                    k > 1 else None
-                if stacked is not None:
-                    outs = self.exe.run(self.main_program, feed=stacked,
-                                        fetch_list=fetch_list,
-                                        iterations=k, stacked_feed=True)
-                else:
-                    for feed in group:
-                        outs = self.exe.run(self.main_program, feed=feed,
-                                            fetch_list=fetch_list)
-                cost = float(np.asarray(_dense(outs[0])).reshape(-1)[0])
-                metrics = {k_: _dense(v) for k_, v in
-                           zip(fetch_names, outs[1:])}
-                costs.append(cost)
-                self.step += len(group)
-                handler(EndIteration(pass_id, dispatch_id, cost,
-                                     metrics))
-                self._maybe_checkpoint(advanced=len(group))
-                dispatch_id += 1
-                if len(group) < k:
-                    break
+                    handler(BeginIteration(pass_id, dispatch_id))
+                    stacked = _stackable(group) if len(group) == k and \
+                        k > 1 else None
+                    if stacked is not None:
+                        res = self.exe.run(self.main_program,
+                                           feed=stacked,
+                                           fetch_list=fetch_list,
+                                           iterations=k,
+                                           stacked_feed=True, sync=False)
+                    else:
+                        for i, feed in enumerate(group):
+                            res = self.exe.run(self.main_program,
+                                               feed=feed,
+                                               fetch_list=fetch_list,
+                                               sync=False)
+                            if i < len(group) - 1:
+                                # non-stackable k>1 fallback: only the
+                                # FINAL batch's result feeds the event/
+                                # cost plumbing, so materialize the
+                                # intermediates here — fetch-time
+                                # checks (NaN/Inf) must cover every
+                                # batch, as the sync loop did
+                                res.fetches()
+                    pending.append(res)
+                    self.step += len(group)
+                    logged = (dispatch_id + 1) % log_every == 0
+                    ev = EndIteration(pass_id, dispatch_id, result=res,
+                                      metric_names=fetch_names)
+                    if logged:
+                        ev.cost  # materialize: the periodic sync point
+                    handler(ev)
+                    # logged dispatches flush everything in flight;
+                    # others keep at most log_every results pending —
+                    # but a checkpoint crossing drains fully first, so
+                    # fetch-time checks (CHECK_NAN_INF) raise BEFORE a
+                    # poisoned snapshot can publish as the newest
+                    # resume point
+                    if logged or self._checkpoint_due(len(group)):
+                        _drain(0)
+                    else:
+                        _drain(log_every)
+                    self._maybe_checkpoint(advanced=len(group))
+                    dispatch_id += 1
+                    if len(group) < k:
+                        break
+            finally:
+                if prefetcher is not None:
+                    prefetcher.close()
+            _drain(0)
             handler(EndPass(pass_id, {
                 "mean_cost": float(np.mean(costs)) if costs else None}))
 
+    def _checkpoint_due(self, advanced: int) -> bool:
+        """Did the last `advanced` steps cross an every_n_batches
+        multiple? ("crossed" rather than "== 0": with
+        steps_per_dispatch > 1 the counter advances in strides and may
+        never land exactly on a multiple.)"""
+        cc = self.checkpoint_config
+        return bool(cc) and (self.step // cc.every_n_batches
+                             > (self.step - advanced)
+                             // cc.every_n_batches)
+
     def _maybe_checkpoint(self, advanced: int = 1):
         cc = self.checkpoint_config
-        # "crossed a multiple" rather than "== 0": with
-        # steps_per_dispatch > 1 the counter advances in strides and
-        # may never land exactly on a multiple
-        if cc and (self.step // cc.every_n_batches
-                   > (self.step - advanced) // cc.every_n_batches):
+        if self._checkpoint_due(advanced):
             from .distributed.checkpoint import save_checkpoint
+            # (save_checkpoint itself runs the Executor.synchronize
+            # barrier before snapshotting, covering every caller)
             try:
                 save_checkpoint(cc.dirname, step=self.step,
                                 main_program=self.main_program,
@@ -282,3 +418,9 @@ class Trainer:
 
 def _dense(v):
     return v.data if hasattr(v, "data") else v
+
+
+def _scalar_cost(outs) -> float:
+    """First fetched value (the loss) as a python float — the one cost
+    extraction shared by EndIteration.cost and the pass-mean plumbing."""
+    return float(np.asarray(_dense(outs[0])).reshape(-1)[0])

@@ -36,6 +36,28 @@ def fresh_programs():
 
 
 @pytest.fixture(autouse=True)
+def no_prefetcher_thread_leak():
+    """FeedPrefetcher threads must not outlive their training loop: no
+    test may start with one alive, and none may leak one (mirror of the
+    fault-injector inertness check below)."""
+    import threading
+    import time
+
+    def live():
+        return [t.name for t in threading.enumerate()
+                if t.name.startswith("feed-prefetcher") and t.is_alive()]
+
+    assert not live(), \
+        f"prefetcher thread(s) leaked from a previous test: {live()}"
+    yield
+    # a just-closed prefetcher may need a beat to exit its put poll
+    deadline = time.monotonic() + 2.0
+    while live() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not live(), f"test leaked prefetcher thread(s): {live()}"
+
+
+@pytest.fixture(autouse=True)
 def no_fault_injector_leak():
     """The FaultInjector must be inert outside an explicit scope: no test
     may start with one armed, and none may leak one (chaos in one test

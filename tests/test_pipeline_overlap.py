@@ -1,0 +1,487 @@
+"""Host/device pipelining: async step dispatch (Executor.run sync=False
+-> StepResult), double-buffered feed prefetch (reader.FeedPrefetcher),
+donated train-state, and the checkpoint sync barrier.
+
+The load-bearing invariant throughout: pipelining changes WHERE the host
+waits, never WHAT the device computes — async-vs-sync trained weights
+must be bit-identical.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import executor as core_ex
+from paddle_tpu.reader import FeedPrefetcher
+from paddle_tpu.resilience.faults import FaultInjector
+from paddle_tpu.trainer import CheckpointConfig, EndIteration, EndPass, Trainer
+
+
+def _build_mnist_mlp(seed=0, in_dim=784, hidden=64, classes=10):
+    """MNIST-sized MLP classifier (dims of the book's recognize-digits
+    example, sans conv, so 3 passes stay fast on CPU). Resets the
+    unique-name counter so a rebuild inside one test yields the same
+    parameter names (snapshots compare by name)."""
+    pt.reset_default_programs()
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = seed
+    with pt.program_guard(main, startup):
+        img = layers.data("img", [in_dim])
+        label = layers.data("label", [1], dtype="int64")
+        h = layers.fc(img, size=hidden, act="relu")
+        logits = layers.fc(h, size=classes)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _mnist_reader(n_batches=6, bs=16, in_dim=784, classes=10, seed=7):
+    def read():
+        rng = np.random.RandomState(seed)
+        for _ in range(n_batches):
+            yield {"img": rng.rand(bs, in_dim).astype(np.float32),
+                   "label": rng.randint(0, classes,
+                                        (bs, 1)).astype(np.int64)}
+    return read
+
+
+def _params_snapshot(program):
+    scope = pt.global_scope()
+    return {p.name: np.asarray(scope.get(p.name)).copy()
+            for p in program.all_parameters()}
+
+
+def _train_and_snapshot(passes, reader, **train_kw):
+    main, startup, loss = _build_mnist_mlp()
+    t = Trainer(loss, main_program=main, startup_program=startup)
+    t.train(num_passes=passes, reader=reader, **train_kw)
+    return _params_snapshot(main), main
+
+
+# ---------------------------------------------------------------------------
+# tentpole: async dispatch + lazy fetch
+
+
+def test_step_result_async_matches_sync():
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = 3
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8])
+        y = layers.fc(x, size=4, act="relu")
+    exe = pt.Executor()
+    exe.run(startup)
+    feed = {"x": np.random.RandomState(0).rand(2, 8).astype(np.float32)}
+    (ref,) = exe.run(main, feed=feed, fetch_list=[y])
+    res = exe.run(main, feed=feed, fetch_list=[y], sync=False)
+    assert isinstance(res, pt.StepResult)
+    assert res.fetch_names == [y.name]
+    res.block_until_ready()
+    assert res.ready
+    np.testing.assert_array_equal(res[0], ref)
+    # materialization is cached, indexing/iteration agree
+    assert len(res) == 1
+    np.testing.assert_array_equal(list(res)[0], ref)
+
+
+def test_async_vs_sync_weights_bit_identical():
+    """3 passes, mnist-sized program: the fully pipelined loop (async
+    dispatch, lazy fetch every 4th dispatch, depth-2 feed prefetch)
+    must train to BIT-IDENTICAL weights vs the synchronous loop."""
+    reader = _mnist_reader(n_batches=6)
+    sync_params, _ = _train_and_snapshot(3, reader, log_every=1,
+                                         prefetch=0)
+    pt.reset_global_scope()
+    pipe_params, _ = _train_and_snapshot(3, reader, log_every=4,
+                                         prefetch=2)
+    assert set(sync_params) == set(pipe_params)
+    for name in sync_params:
+        np.testing.assert_array_equal(sync_params[name],
+                                      pipe_params[name], err_msg=name)
+
+
+def test_log_every_lazy_events_and_mean_cost():
+    reader = _mnist_reader(n_batches=8)
+    main, startup, loss = _build_mnist_mlp()
+    seen = []  # (dispatch_id, was_materialized_at_handler_time)
+    passes = []
+
+    def handler(e):
+        if isinstance(e, EndIteration):
+            seen.append((e.batch_id, e._cost is not None))
+        elif isinstance(e, EndPass):
+            passes.append(e.metrics["mean_cost"])
+
+    t = Trainer(loss, main_program=main, startup_program=startup)
+    t.train(num_passes=1, reader=reader, event_handler=handler,
+            log_every=3)
+    # logged dispatches (every 3rd) carry a concrete cost; the others a
+    # lazy handle the trainer did not force
+    assert [m for _, m in seen] == \
+        [(i + 1) % 3 == 0 for i in range(8)]
+    # the lazy handles still materialize on demand, and the pass mean
+    # matches the synchronous loop exactly
+    pt.reset_global_scope()
+    main2, startup2, loss2 = _build_mnist_mlp()
+    sync_passes = []
+    t2 = Trainer(loss2, main_program=main2, startup_program=startup2)
+    t2.train(num_passes=1, reader=reader,
+             event_handler=lambda e: sync_passes.append(
+                 e.metrics["mean_cost"]) if isinstance(e, EndPass)
+             else None)
+    assert passes == sync_passes
+
+
+def test_async_fetch_of_donated_state_raises():
+    main, startup, loss = _build_mnist_mlp()
+    exe = pt.Executor()
+    exe.run(startup)
+    w = main.all_parameters()[0].name
+    feed = next(iter(_mnist_reader(n_batches=1)()))
+    with pytest.raises(ValueError, match="donated state"):
+        exe.run(main, feed=feed, fetch_list=[loss, w], sync=False)
+    # the same fetch is fine synchronously (materialized before the
+    # next step can donate the buffer) ...
+    outs = exe.run(main, feed=feed, fetch_list=[loss, w])
+    assert np.asarray(outs[1]).shape == (784, 64)
+    # ... and fine asynchronously with donation off
+    exe2 = pt.Executor(donate_state=False)
+    res = exe2.run(main, feed=feed, fetch_list=[loss, w], sync=False)
+    assert np.asarray(res[1]).shape == (784, 64)
+
+
+def test_donation_feed_cache_non_interference():
+    """State donation must not disturb the device-side feed cache: a
+    frozen batch fed every step keeps its one device copy (donation
+    rewrites STATE buffers, never feed buffers)."""
+    main, startup, loss = _build_mnist_mlp()
+    exe = pt.Executor()
+    assert exe.donate_state  # default on
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    img = rng.rand(16, 784).astype(np.float32)
+    lbl = rng.randint(0, 10, (16, 1)).astype(np.int64)
+    for a in (img, lbl):
+        assert a.flags.owndata
+        a.flags.writeable = False
+    costs = []
+    for _ in range(3):
+        res = exe.run(main, feed={"img": img, "label": lbl},
+                      fetch_list=[loss], sync=False)
+        costs.append(float(np.asarray(res[0])))
+    # training happened (donated state advanced)...
+    assert costs[2] < costs[0]
+    # ...and the frozen feed's cached device copy is alive and still
+    # THE cached entry for this array
+    entry = core_ex._feed_cache.get(id(img))
+    assert entry is not None and entry[0]() is img
+    assert not entry[1].is_deleted()
+
+
+# ---------------------------------------------------------------------------
+# feed prefetcher
+
+
+def test_prefetcher_basic_and_clean_shutdown():
+    produced = list(range(10))
+    p = FeedPrefetcher(iter(produced), convert=lambda x: x * 2, depth=2)
+    assert list(p) == [x * 2 for x in produced]
+    assert not p._thread.is_alive()
+    p.close()  # idempotent
+    # exhausted iterator keeps raising StopIteration
+    with pytest.raises(StopIteration):
+        next(p)
+
+
+def test_prefetcher_exception_propagates_and_joins():
+    def gen():
+        yield {"x": 1}
+        raise ValueError("reader blew up")
+
+    p = FeedPrefetcher(gen(), depth=2)
+    assert next(p) == {"x": 1}
+    with pytest.raises(ValueError, match="reader blew up"):
+        next(p)
+    p._thread.join(timeout=5)
+    assert not p._thread.is_alive()
+
+
+def test_prefetcher_close_unblocks_full_queue_producer():
+    def endless():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    p = FeedPrefetcher(endless(), depth=2)
+    assert next(p) == 0
+    # producer is (or soon will be) blocked on the full queue
+    time.sleep(0.05)
+    p.close()
+    assert not p._thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(p)
+
+
+def test_prefetcher_cross_thread_close_unblocks_consumer():
+    """close() from ANOTHER thread must wake a consumer blocked on an
+    empty queue (slow reader), not strand it in the untimed get()."""
+    release = threading.Event()
+
+    def slow():
+        yield 1
+        release.wait(10)  # consumer will block on the empty queue here
+        yield 2
+
+    p = FeedPrefetcher(slow(), depth=2)
+    assert next(p) == 1
+    got = []
+
+    def consume():
+        try:
+            got.append(next(p))
+        except StopIteration:
+            got.append("stop")
+
+    c = threading.Thread(target=consume)
+    c.start()
+    time.sleep(0.05)  # let the consumer block in q.get()
+    p.close()
+    c.join(timeout=5)
+    release.set()
+    assert not c.is_alive(), "consumer stranded after cross-thread close"
+    assert got == ["stop"]
+
+
+def test_prefetcher_convert_error_propagates():
+    def bad_convert(b):
+        raise TypeError("cannot convert")
+
+    p = FeedPrefetcher(iter([1, 2]), convert=bad_convert, depth=2)
+    with pytest.raises(TypeError, match="cannot convert"):
+        next(p)
+    assert not p._thread.is_alive()
+
+
+def test_data_feeder_feed_device():
+    import jax
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        lbl = layers.data("lbl", [1], dtype="int64")
+        y = layers.fc(x, size=2)
+    feeder = pt.DataFeeder([x, lbl])
+    batch = [(np.arange(4, dtype=np.float32), 1),
+             (np.ones(4, dtype=np.float32), 0)]
+    dev = feeder.feed_device(batch)
+    assert all(isinstance(v, jax.Array) for v in dev.values())
+    # the executor accepts device-form feeds unchanged
+    exe = pt.Executor()
+    exe.run(startup)
+    (out_dev,) = exe.run(main, feed=dev, fetch_list=[y])
+    (out_host,) = exe.run(main, feed=feeder.feed(batch), fetch_list=[y])
+    np.testing.assert_array_equal(out_dev, out_host)
+
+
+@pytest.mark.chaos
+def test_chaos_reader_next_armed_through_prefetcher():
+    """The prefetcher's producer thread fires `reader.next` per pulled
+    batch: an injected fault mid-pass re-raises in the training loop,
+    the prefetcher shuts down cleanly (conftest asserts no thread
+    leak), and training up to the fault really happened."""
+    main, startup, loss = _build_mnist_mlp()
+    t = Trainer(loss, main_program=main, startup_program=startup)
+    before = None
+    with FaultInjector(seed=11) as fi:
+        fi.on("reader.next", raises=RuntimeError, after=2, times=1)
+        t.start()
+        before = _params_snapshot(main)
+        with pytest.raises(RuntimeError, match="injected fault"):
+            t.train(num_passes=1, reader=_mnist_reader(n_batches=8),
+                    prefetch=2, log_every=4)
+        assert fi.triggered("reader.next") == 1
+        assert fi.calls("reader.next") >= 3
+    after = _params_snapshot(main)
+    # the two pre-fault batches trained before the pipeline died
+    assert any(not np.array_equal(before[n], after[n]) for n in before)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint barrier
+
+
+def test_checkpoint_during_async_training_not_torn(tmp_path):
+    """A checkpoint saved mid-pass under full pipelining must snapshot
+    exactly the post-step-4 weights — bit-identical to a synchronous
+    run of the same 4 batches (a torn/stale snapshot under async
+    dispatch + donation fails this)."""
+    d = str(tmp_path / "ck")
+    reader8 = _mnist_reader(n_batches=8)
+    main, startup, loss = _build_mnist_mlp()
+    t = Trainer(loss, main_program=main, startup_program=startup,
+                checkpoint_config=CheckpointConfig(d, every_n_batches=4,
+                                                   max_keep=3))
+    t.train(num_passes=1, reader=reader8, log_every=8, prefetch=2)
+
+    # synchronous reference: same program/seed over the FIRST 4 batches
+    pt.reset_global_scope()
+    main2, startup2, loss2 = _build_mnist_mlp()
+    t2 = Trainer(loss2, main_program=main2, startup_program=startup2)
+    t2.train(num_passes=1, reader=_mnist_reader(n_batches=4))
+    ref = _params_snapshot(main2)
+
+    # load the mid-pass checkpoint into a fresh scope and compare
+    pt.reset_global_scope()
+    exe = pt.Executor()
+    pt.io.load_persistables(exe, str(tmp_path / "ck" / "checkpoint_4"),
+                            main)
+    got = {p.name: np.asarray(pt.global_scope().get(p.name))
+           for p in main.all_parameters()}
+    for name in ref:
+        np.testing.assert_array_equal(ref[name], got[name], err_msg=name)
+
+
+def test_serving_async_dispatch_matches_direct(tmp_path):
+    """Engine-level pipelining (async_dispatch=True): results stay
+    bit-identical to a direct run, single requests complete promptly
+    (the worker must not park a dispatched batch behind the batcher's
+    deadline), and stop() drains the in-flight pipeline."""
+    from paddle_tpu import serving
+
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = 0
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8])
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=4, act="softmax")
+    exe = pt.Executor()
+    exe.run(startup)
+    dirname = str(tmp_path / "model")
+    pt.io.save_inference_model(dirname, ["x"], [pred], exe, main)
+
+    model = serving.load(dirname)
+    rng = np.random.RandomState(0)
+    feeds = [{"x": rng.rand(4, 8).astype(np.float32)} for _ in range(8)]
+    direct = [model.run_direct(f)[0] for f in feeds]
+
+    engine = model.serve(serving.BatchingConfig(
+        max_batch_size=4, batch_buckets=[4], max_latency_ms=1.0),
+        async_dispatch=True)
+    assert engine.async_dispatch
+    engine.start(warmup=False)
+    try:
+        # lone request: must not wait for a successor batch
+        t0 = time.monotonic()
+        (one,) = engine.predict(feeds[0], timeout=30)
+        assert time.monotonic() - t0 < 10
+        np.testing.assert_array_equal(one, direct[0])
+        # sustained load: pipelined batches, results still exact
+        futs = [engine.submit(f) for f in feeds]
+        for f, ref in zip(futs, direct):
+            (got,) = f.result(timeout=30)
+            np.testing.assert_array_equal(got, ref)
+        assert engine.stats()["async_dispatch"] is True
+    finally:
+        engine.stop(drain=True, timeout=60)
+
+
+def test_while_grad_probe_async_bit_identical():
+    """WhileGrad's probe-and-replay interacts with async dispatch: the
+    trip-count probe reads the CURRENT state and materializes counts
+    before each dispatch (an inherent per-step sync point). Training a
+    dynamic-While program asynchronously must still produce bit-identical
+    weights — including across a mid-training trip-count/bucket change."""
+    from paddle_tpu.layers import control_flow as cf
+
+    def build():
+        pt.reset_default_programs()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.create_parameter(
+                shape=[1], dtype="float32", name="xparam",
+                default_initializer=pt.initializer.ConstantInitializer(
+                    0.3))
+            thr = layers.data("thr", [1], dtype="float32")
+            s = layers.fill_constant([1], "float32", 0.0)
+            s.stop_gradient = False
+            cond = cf.less_than_v(s, thr)
+            w = cf.While(cond)  # NO max_steps: dynamic trip count
+            with w.block():
+                t = layers.elementwise_add(s, x)
+                layers.assign(t, output=s)
+                cf.less_than_v(s, thr, cond=cond)
+            tgt = layers.fill_constant([1], "float32", 2.0)
+            loss = layers.reduce_sum(
+                layers.square(layers.elementwise_sub(s, tgt)))
+            pt.optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
+        return main, loss, startup
+
+    # thresholds chosen so the probed trip count (and pow2 bucket)
+    # changes mid-run
+    thrs = [np.asarray([v], np.float32) for v in (1.0, 2.5, 1.0, 4.0)]
+
+    def train(sync):
+        main, loss, startup = build()
+        exe = pt.Executor()
+        exe.run(startup)
+        for thr in thrs:
+            r = exe.run(main, feed={"thr": thr}, fetch_list=[loss],
+                        sync=sync)
+            if not sync:
+                assert isinstance(r, pt.StepResult)
+        exe.synchronize()
+        return np.asarray(pt.global_scope().get("xparam")).copy()
+
+    ref = train(sync=True)
+    pt.reset_global_scope()
+    got = train(sync=False)
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_nan_check_fires_before_checkpoint_publishes(tmp_path,
+                                                     monkeypatch):
+    """CHECK_NAN_INF under lazy fetch (log_every > 1): a NaN produced
+    BEFORE a checkpoint crossing must raise at the crossing's drain —
+    before the save publishes a poisoned snapshot as the newest resume
+    point (the sync loop raised at the offending step; async defers
+    the check to materialization, so the crossing drains first)."""
+    monkeypatch.setattr(core_ex, "CHECK_NAN_INF", True)
+    d = str(tmp_path / "ck")
+    main, startup, loss = _build_mnist_mlp()
+
+    def reader():
+        rng = np.random.RandomState(0)
+        for i in range(8):
+            img = rng.rand(16, 784).astype(np.float32)
+            if i == 2:
+                img[0, 0] = np.nan  # poisons step 3's loss
+            yield {"img": img,
+                   "label": rng.randint(0, 10, (16, 1)).astype(np.int64)}
+
+    t = Trainer(loss, main_program=main, startup_program=startup,
+                checkpoint_config=CheckpointConfig(d, every_n_batches=4))
+    with pytest.raises(FloatingPointError):
+        t.train(num_passes=1, reader=reader, log_every=8)
+    saved = [x for x in os.listdir(d)
+             if x.startswith("checkpoint_") and not x.endswith(".tmp")] \
+        if os.path.isdir(d) else []
+    assert not saved, f"poisoned checkpoint published: {saved}"
+
+
+def test_executor_synchronize_clears_inflight():
+    main, startup, loss = _build_mnist_mlp()
+    exe = pt.Executor()
+    exe.run(startup)
+    feed = next(iter(_mnist_reader(n_batches=1)()))
+    exe.run(main, feed=feed, fetch_list=[loss], sync=False)
+    assert exe._inflight_state
+    exe.synchronize()
+    assert not exe._inflight_state
+    # all scope state readable after the barrier (nothing deleted)
+    for p in main.all_parameters():
+        np.asarray(pt.global_scope().get(p.name))
