@@ -35,6 +35,7 @@ from . import dataset  # noqa  (reference paddle/__init__.py imports it)
 from .reader import batch  # noqa
 from . import concurrency  # noqa
 from . import amp  # noqa
+from . import observability  # noqa  (metrics registry, step tracing, telemetry endpoint)
 from . import resilience  # noqa  (fault injection, retry/backoff, circuit breaker)
 from . import serving  # noqa  (inference server: dynamic batching + bucketed compile cache)
 

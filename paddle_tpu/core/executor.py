@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from ..amp import amp_enabled
 from .. import profiler
+from ..observability.registry import default_registry
 from .ir import Program, BlockDesc, OpDesc
 from .lod import LoDTensor, RaggedNested, RaggedPair, RaggedTree
 from .registry import OpRegistry, run_op
@@ -449,6 +450,37 @@ def _stateful_ops_in(program: Program, ops) -> List[str]:
     return found
 
 
+# Process-wide executor metrics, resolved lazily against the CURRENT
+# default registry (identity-checked per call so a registry swap —
+# tests, the telemetry-overhead benchmark — takes effect on the next
+# run() without re-importing). Aggregated across executors: the scrape
+# answers "how much compilation is this process paying", which is the
+# capacity question; per-executor splits stay on Executor.cache_stats.
+_obs_cache = None
+
+
+def _obs_instruments():
+    global _obs_cache
+    reg = default_registry()
+    if _obs_cache is None or _obs_cache[0] is not reg:
+        _obs_cache = (
+            reg,
+            reg.counter(
+                "paddle_tpu_compile_cache_hits_total",
+                "Executor.run dispatches served by an already-jitted "
+                "executable (all executors in this process)."),
+            reg.counter(
+                "paddle_tpu_compile_cache_misses_total",
+                "Executor.run dispatches that traced + XLA-compiled a "
+                "new executable (all executors in this process)."),
+            reg.gauge(
+                "paddle_tpu_executor_donate_state",
+                "1 when the most recent Executor.run dispatched with "
+                "donated (buffer-aliased) train state, else 0."),
+        )
+    return _obs_cache
+
+
 # Deferred bounded-While truncation flags are normally checked one run
 # later (so the warn path never syncs the just-dispatched step); flush
 # them at interpreter exit so a truncation on a session's FINAL run
@@ -799,9 +831,12 @@ class Executor:
                                iterations=iterations,
                                stacked_feed=stacked_feed,
                                donate=self.donate_state)
+        _, obs_hits, obs_misses, obs_donate = _obs_instruments()
+        obs_donate.set(1.0 if self.donate_state else 0.0)
         compiled = self._cache.get(key)
         if compiled is None:
             self.cache_stats["misses"] += 1
+            obs_misses.inc()
             kw = {} if iterations == 1 else {
                 "iterations": iterations,
                 "or_reduce_tail": len(exhausted),
@@ -812,6 +847,7 @@ class Executor:
             self._cache[key] = compiled
         else:
             self.cache_stats["hits"] += 1
+            obs_hits.inc()
 
         if not sync and self.donate_state:
             rw = set(compiled.rw_names)

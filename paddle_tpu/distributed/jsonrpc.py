@@ -11,6 +11,14 @@ resilience.RetryPolicy — the next attempt reconnects; non-transport
 Subclasses customize: `_handle_resp` (e.g. raise on an {"error": ...}
 reply), `_retry_name` (the retry-counter/profiler label), and pass a
 per-call `fault_point` to arm chaos-test injection on specific methods.
+
+Trace-context propagation (observability/trace.py): when a StepTrace
+span is active on the calling thread, every wire ATTEMPT stamps the
+current {trace_id, span_id} into the request (`req["trace"]`) and is
+recorded as an `rpc::<op>` profiler event (cat=CAT_RPC) carrying the
+same ids — so all retries of one logical call, and the server-side
+handling of a redelivered RPC, are attributable to the training step
+that issued it. Servers treat the field as opaque metadata.
 """
 from __future__ import annotations
 
@@ -19,6 +27,8 @@ import socket
 import threading
 from typing import Optional
 
+from .. import profiler
+from ..observability import trace as obs_trace
 from ..resilience import faults
 from ..resilience.retry import RetryError, RetryPolicy
 
@@ -78,23 +88,32 @@ class JSONLinesClient:
         return "jsonrpc"
 
     def _attempt(self, req: dict, fault_point: Optional[str]) -> dict:
-        if fault_point:
-            faults.fire(fault_point)
-        if self._file is None:
-            self._connect()
-        self._file.write((json.dumps(req) + "\n").encode())
-        self._file.flush()
-        line = self._file.readline()
-        if not line:
-            raise ConnectionError("server closed connection")
-        try:
-            resp = json.loads(line)
-        except json.JSONDecodeError as e:
-            # a torn reply line (server died mid-write) is a dropped
-            # connection, classified HERE so every retry policy sees a
-            # transport error without having to know the wire format
-            raise ConnectionError(
-                f"torn reply from {self.endpoint}: {e}") from e
+        # stamp the CURRENT trace context per attempt (not once per
+        # call): a retried RPC re-sends the same trace/span id, which
+        # is exactly what makes redelivery attributable server-side
+        ctx = obs_trace.current()
+        if ctx is not None:
+            req = dict(req, trace=ctx.wire())
+        with profiler.RecordEvent(f"rpc::{self._retry_name(req)}",
+                                  cat=profiler.CAT_RPC):
+            if fault_point:
+                faults.fire(fault_point)
+            if self._file is None:
+                self._connect()
+            self._file.write((json.dumps(req) + "\n").encode())
+            self._file.flush()
+            line = self._file.readline()
+            if not line:
+                raise ConnectionError("server closed connection")
+            try:
+                resp = json.loads(line)
+            except json.JSONDecodeError as e:
+                # a torn reply line (server died mid-write) is a dropped
+                # connection, classified HERE so every retry policy sees
+                # a transport error without having to know the wire
+                # format
+                raise ConnectionError(
+                    f"torn reply from {self.endpoint}: {e}") from e
         return self._handle_resp(resp)
 
     def _on_retry(self, attempt: int, exc: BaseException):

@@ -142,6 +142,12 @@ class _Handler(socketserver.StreamRequestHandler):
         for line in self.rfile:
             try:
                 req = json.loads(line)
+                # propagated StepTrace context (jsonrpc stamps it per
+                # attempt): kept as the server's last-seen trace so an
+                # operator (or test) can attribute the RPC to the
+                # training step that issued it
+                if "trace" in req:
+                    self.server.last_trace = req["trace"]  # type: ignore
                 method = req.get("method")
                 if method == "get_task":
                     payload, tid, epoch = master.get_task()
@@ -193,6 +199,7 @@ class MasterServer:
 
         self._server = _Server((host, port), _Handler)
         self._server.master = master  # type: ignore[attr-defined]
+        self._server.last_trace = None  # type: ignore[attr-defined]
         self.endpoint = "{}:{}".format(*self._server.server_address)
         self._threads = [
             threading.Thread(target=self._server.serve_forever,
@@ -206,6 +213,12 @@ class MasterServer:
         for t in self._threads:
             t.start()
         return self
+
+    @property
+    def last_trace(self):
+        """Trace context of the most recent RPC that carried one
+        ({"trace_id", "span_id"} from the client's StepTrace span)."""
+        return self._server.last_trace  # type: ignore[attr-defined]
 
     def _ticker(self, interval):
         while not self._stop.wait(interval):

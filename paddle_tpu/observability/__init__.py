@@ -1,0 +1,45 @@
+"""paddle_tpu.observability — unified metrics registry, step tracing,
+and a scrapeable telemetry endpoint.
+
+Three pieces (see each module's docstring for the design argument):
+
+- ``registry``: process-wide MetricsRegistry — labeled counters,
+  gauges, and windowed histograms (nearest-rank p50/p90/p99) behind
+  validated ``paddle_tpu_*`` names with mandatory help text. Every
+  built-in producer publishes here: ServingMetrics is a facade over
+  it, ``retry_counters()`` and live CircuitBreakers mirror themselves
+  in via collectors, and the Trainer/Executor publish step time,
+  compile-cache hits/misses, prefetch depth, and the donated-state
+  toggle.
+- ``trace``: StepTrace spans over the existing profiler events —
+  ``step_trace(step)`` stamps every RecordEvent closed inside with a
+  shared trace/span id, and distributed/jsonrpc.py propagates the
+  context on every RPC attempt so master/pserver traffic is
+  attributable to a training step.
+- ``server``: TelemetryServer — stdlib HTTP serving ``/metrics``
+  (Prometheus text exposition), ``/healthz`` (from
+  resilience.health), and ``/statusz`` (JSON snapshot).
+
+Quickstart::
+
+    from paddle_tpu import observability as obs
+
+    srv = obs.TelemetryServer(port=9187, health=engine.health)
+    srv.add_status("serving", engine.stats)
+    srv.start()
+    # curl :9187/metrics   -> one scrape: training + serving + resilience
+"""
+from . import trace  # noqa: F401
+from .registry import (METRIC_NAME_RE, Counter, Gauge,  # noqa: F401
+                       Histogram, MetricsRegistry, add_global_collector,
+                       default_registry, set_default_registry)
+from .server import TelemetryServer  # noqa: F401
+from .trace import SpanContext, current, span, step_trace  # noqa: F401
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "default_registry", "set_default_registry", "add_global_collector",
+    "METRIC_NAME_RE",
+    "TelemetryServer",
+    "trace", "SpanContext", "step_trace", "span", "current",
+]

@@ -1,0 +1,180 @@
+"""TelemetryServer: stdlib-http scrape endpoint for the registry.
+
+Endpoints:
+
+- ``GET /metrics``  Prometheus text exposition (0.0.4) of the bound
+  MetricsRegistry — counters, gauges, and windowed histograms rendered
+  as summaries (p50/p90/p99 quantile samples + ``_sum``/``_count``).
+- ``GET /healthz``  liveness/readiness JSON. Bound to a health source
+  (anything with ``.healthy`` and optionally ``.snapshot()`` — e.g.
+  resilience.health.HealthMonitor): 200 while healthy, 503 once the
+  breaker is open. With no source, a live process answers 200.
+- ``GET /statusz``  one JSON snapshot: the registry dump plus every
+  registered status provider (e.g. a ServingEngine's ``stats()``,
+  ``retry_counters()``) — the human-debuggable sibling of /metrics.
+
+Lifecycle: ``start()`` binds (port 0 = ephemeral, for tests — read
+``.port``/``.url`` after), a daemon thread serves, ``stop()`` shuts the
+listener down and joins the thread. Also usable as a context manager.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from .registry import MetricsRegistry, default_registry
+
+__all__ = ["TelemetryServer"]
+
+#: content type mandated by the Prometheus text exposition format
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server: "_Server"
+
+    def do_GET(self):  # noqa: N802 (stdlib handler naming)
+        owner: "TelemetryServer" = self.server.owner
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                body = owner.registry.render_prometheus().encode()
+                self._reply(200, PROMETHEUS_CONTENT_TYPE, body)
+            elif path == "/healthz":
+                code, payload = owner._healthz()
+                self._reply_json(code, payload)
+            elif path == "/statusz":
+                self._reply_json(200, owner._statusz())
+            else:
+                self._reply_json(404, {"error": f"no such path {path!r}",
+                                       "paths": ["/metrics", "/healthz",
+                                                 "/statusz"]})
+        except (BrokenPipeError, ConnectionError):
+            # the scraper hung up mid-reply (timeout, Ctrl-C): there is
+            # no socket left to answer on — attempting a 500 here would
+            # raise again and dump a socketserver traceback into the
+            # training log on every aborted scrape
+            return
+        except Exception as e:  # a broken provider must not kill serving
+            try:
+                self._reply_json(500, {"error": repr(e)})
+            except OSError:
+                pass  # client also gone; nothing to report to
+
+    def _reply(self, code: int, ctype: str, body: bytes):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_json(self, code: int, payload):
+        self._reply(code, "application/json",
+                    json.dumps(payload, default=repr).encode())
+
+    def log_message(self, fmt, *args):
+        pass  # scrapes are periodic; never spam the training log
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    owner: "TelemetryServer"
+
+
+class TelemetryServer:
+    """Scrape endpoint over a MetricsRegistry + optional health source
+    and named status providers.
+
+        srv = TelemetryServer(port=0, health=engine.health)
+        srv.add_status("serving", engine.stats)
+        srv.start()
+        ... GET http://{srv.url}/metrics ...
+        srv.stop()
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 health=None, host: str = "127.0.0.1", port: int = 9187,
+                 status: Optional[Dict[str, Callable[[], object]]] = None):
+        self._registry = registry
+        self.health = health
+        self.host = host
+        self._requested_port = int(port)
+        self._status: Dict[str, Callable[[], object]] = dict(status or {})
+        self._server: Optional[_Server] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        # resolved at scrape time so a default-registry swap (tests,
+        # benchmarks) is reflected without rebuilding the server
+        return self._registry if self._registry is not None \
+            else default_registry()
+
+    def add_status(self, name: str, fn: Callable[[], object]) -> None:
+        """Register a JSON-able callable under /statusz["status"][name]."""
+        self._status[name] = fn
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "TelemetryServer":
+        if self._server is not None:
+            raise RuntimeError("telemetry server already started")
+        self._server = _Server((self.host, self._requested_port), _Handler)
+        self._server.owner = self
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="telemetry-server", daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        if self._server is None:
+            raise RuntimeError("telemetry server not started")
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self, timeout: Optional[float] = 5.0) -> None:
+        """Graceful stop: close the listener, finish in-flight replies
+        (handler threads are daemons), join the accept loop."""
+        server, self._server = self._server, None
+        thread, self._thread = self._thread, None
+        if server is None:
+            return
+        server.shutdown()
+        server.server_close()
+        if thread is not None:
+            thread.join(timeout=timeout)
+
+    def __enter__(self) -> "TelemetryServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    # -- endpoint payloads ---------------------------------------------
+    def _healthz(self):
+        h = self.health
+        if h is None:
+            return 200, {"status": "ok"}
+        healthy = bool(h.healthy() if callable(h.healthy) else h.healthy)
+        payload = {"status": "ok" if healthy else "unhealthy"}
+        snap = getattr(h, "snapshot", None)
+        if callable(snap):
+            payload["health"] = snap()
+        return (200 if healthy else 503), payload
+
+    def _statusz(self):
+        status = {}
+        for name, fn in sorted(self._status.items()):
+            try:
+                status[name] = fn()
+            except Exception as e:  # one broken provider, not the page
+                status[name] = {"error": repr(e)}
+        return {"metrics": self.registry.snapshot(), "status": status}

@@ -10,11 +10,31 @@ from __future__ import annotations
 
 import contextlib
 import json
+import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 _events: List[Dict] = []
 _enabled = False
+# Guards _events against concurrent RecordEvent emission (serving
+# workers, prefetcher, trainer thread all append) racing a reader:
+# export_chrome_trace/events/summary snapshot the list under this lock
+# instead of iterating the live list, so a mid-export append can never
+# tear the JSON or skip/duplicate events.
+_events_lock = threading.Lock()
+
+# Optional callback returning {"trace_id": ..., "span_id": ...} for the
+# current thread — installed by observability.trace so every event
+# closed under an active StepTrace span is attributable to its step.
+# Kept as a late-bound hook: the profiler must not import observability.
+_trace_args_provider: Optional[Callable[[], Optional[Dict]]] = None
+
+
+def set_trace_args_provider(fn: Optional[Callable[[], Optional[Dict]]]):
+    """Install a callable whose (dict) result is merged into each
+    recorded event's chrome-trace ``args`` (None = no-op)."""
+    global _trace_args_provider
+    _trace_args_provider = fn
 
 # Event categories ("cat" in the chrome-trace schema). Host events from
 # the serving runtime (paddle_tpu.serving) are tagged so a trace of a
@@ -33,15 +53,27 @@ CAT_RESILIENCE = "resilience"
 #                             Executor.synchronize) and inline
 #                             (un-prefetched) reader+feed assembly
 CAT_PIPELINE = "pipeline"
+# Per-attempt RPC spans from distributed/jsonrpc.py (rpc::<op>): one
+# event per wire attempt, so retried calls show as distinct spans that
+# share the originating step's trace id.
+CAT_RPC = "rpc"
+# StepTrace root/child spans (observability/trace.py): trace::step/N
+# covers one dispatched training step; every event closed inside it
+# carries the step's trace_id/span_id in its args.
+CAT_TRACE = "trace"
 
 
 class RecordEvent:
     """RAII event (reference: profiler.h:106). `cat` is an optional
-    chrome-trace category (e.g. CAT_SERVING) used to filter summaries."""
+    chrome-trace category (e.g. CAT_SERVING) used to filter summaries;
+    `args` lands in the chrome-trace event's args dict (merged with the
+    active StepTrace context, when one is installed)."""
 
-    def __init__(self, name: str, cat: Optional[str] = None):
+    def __init__(self, name: str, cat: Optional[str] = None,
+                 args: Optional[Dict] = None):
         self.name = name
         self.cat = cat
+        self.args = args
         self.t0 = None
 
     def __enter__(self):
@@ -55,19 +87,30 @@ class RecordEvent:
                   "ph": "X", "pid": 0, "tid": 0}
             if self.cat:
                 ev["cat"] = self.cat
-            _events.append(ev)
+            args = dict(self.args) if self.args else {}
+            if _trace_args_provider is not None:
+                targs = _trace_args_provider()
+                if targs:
+                    args.update(targs)
+            if args:
+                ev["args"] = args
+            with _events_lock:
+                _events.append(ev)
         return False
 
 
 def events(cat: Optional[str] = None) -> List[Dict]:
     """Snapshot of recorded host events, optionally filtered by category."""
-    return [e for e in _events if cat is None or e.get("cat") == cat]
+    with _events_lock:
+        snap = list(_events)
+    return [e for e in snap if cat is None or e.get("cat") == cat]
 
 
 def start_profiler(state: str = "All"):
     global _enabled
     _enabled = True
-    _events.clear()
+    with _events_lock:
+        _events.clear()
 
 
 def stop_profiler(sorted_key: Optional[str] = None,
@@ -81,9 +124,7 @@ def stop_profiler(sorted_key: Optional[str] = None,
 
 def summary(cat: Optional[str] = None):
     agg: Dict[str, Dict] = {}
-    for e in _events:
-        if cat is not None and e.get("cat") != cat:
-            continue
+    for e in events(cat=cat):
         a = agg.setdefault(e["name"], {"calls": 0, "total_us": 0.0})
         a["calls"] += 1
         a["total_us"] += e["dur"]
@@ -91,8 +132,13 @@ def summary(cat: Optional[str] = None):
 
 
 def export_chrome_trace(path: str):
+    # snapshot under the lock: exporting while serving workers /
+    # prefetcher threads still emit RecordEvents must serialize a
+    # consistent list, not iterate one being appended to
+    with _events_lock:
+        snap = list(_events)
     with open(path, "w") as f:
-        json.dump({"traceEvents": _events}, f)
+        json.dump({"traceEvents": snap}, f)
 
 
 @contextlib.contextmanager
@@ -212,8 +258,9 @@ def merged_profile(logdir: str = "/tmp/paddle_tpu_xprof"):
 
     global _enabled
     prof = MergedProfile()
-    prev_events = list(_events)
-    _events.clear()
+    with _events_lock:
+        prev_events = list(_events)
+        _events.clear()
     _enabled = True
     jax.profiler.start_trace(logdir)
     try:
@@ -221,9 +268,10 @@ def merged_profile(logdir: str = "/tmp/paddle_tpu_xprof"):
     finally:
         jax.profiler.stop_trace()
         _enabled = False
-        prof.host_events = list(_events)
-        _events.clear()
-        _events.extend(prev_events)
+        with _events_lock:
+            prof.host_events = list(_events)
+            _events.clear()
+            _events.extend(prev_events)
         try:
             prof.device_events = _parse_device_trace(logdir)
         except Exception:

@@ -20,9 +20,13 @@ and last-error capture, and renders everything JSON-able for
 from __future__ import annotations
 
 import collections
+import itertools
 import threading
 import time
+import weakref
 from typing import Callable, Dict, Optional
+
+from ..observability.registry import add_global_collector
 
 __all__ = ["CircuitBreaker", "CircuitOpenError", "HealthMonitor",
            "CLOSED", "OPEN", "HALF_OPEN", "PROBE"]
@@ -30,6 +34,43 @@ __all__ = ["CircuitBreaker", "CircuitOpenError", "HealthMonitor",
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
+
+#: gauge encoding for paddle_tpu_circuit_breaker_state
+_STATE_CODE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+#: live breakers, each holding a stable `breaker="<n>"` label; the
+#: scrape-time collector below mirrors their state into the metrics
+#: registry and prunes series whose breaker was garbage-collected
+_breaker_ids = itertools.count()
+_live_breakers: "weakref.WeakSet[CircuitBreaker]" = weakref.WeakSet()
+
+
+def _collect_breaker_metrics(reg) -> None:
+    state_g = reg.gauge(
+        "paddle_tpu_circuit_breaker_state",
+        "Serving circuit-breaker state: 0 closed, 1 open (shedding), "
+        "2 half-open (probing).", ("breaker",))
+    opened = reg.counter(
+        "paddle_tpu_circuit_breaker_opened_total",
+        "Times this breaker tripped open.", ("breaker",))
+    shed = reg.counter(
+        "paddle_tpu_circuit_breaker_shed_total",
+        "Requests fast-failed while this breaker was open.", ("breaker",))
+    live = list(_live_breakers)
+    keys = set()
+    for b in live:
+        snap = b.snapshot()
+        keys.add((b._obs_label,))
+        state_g.labels(breaker=b._obs_label).set(
+            _STATE_CODE.get(snap["state"], -1))
+        opened.labels(breaker=b._obs_label).set_total(
+            snap["opened_total"])
+        shed.labels(breaker=b._obs_label).set_total(snap["shed_total"])
+    for fam in (state_g, opened, shed):
+        fam.retain(keys)
+
+
+add_global_collector(_collect_breaker_metrics)
 
 #: truthy sentinel returned by allow_request() when the admission
 #: consumed a half-open probe slot — callers that fail to turn the
@@ -70,6 +111,12 @@ class CircuitBreaker:
         self._probe_taken_at: Optional[float] = None
         self.opened_total = 0   # times the circuit opened
         self.shed_total = 0     # requests fast-failed while open
+        # self-registration with the metrics registry: a stable series
+        # label for this breaker's lifetime; the module collector
+        # mirrors snapshot() into paddle_tpu_circuit_breaker_* at
+        # scrape time and drops the series once we're collected
+        self._obs_label = str(next(_breaker_ids))
+        _live_breakers.add(self)
 
     @property
     def state(self) -> str:

@@ -16,7 +16,11 @@ Observability: every retry is counted in a module-level registry
 (`retry_counters()`) keyed by the operation name, and — when the
 profiler is enabled — recorded as a `retry::<name>` event spanning the
 backoff sleep (cat=profiler.CAT_RESILIENCE), so a chrome trace of a
-flaky run shows exactly where time went to backoff.
+flaky run shows exactly where time went to backoff. The counters also
+mirror themselves into the observability MetricsRegistry at scrape
+time (paddle_tpu_retry_{calls,retries,failures}_total{op=...}) via a
+global collector, so one /metrics scrape shows per-op retry pressure;
+`retry_counters()` itself keeps its dict shape.
 """
 from __future__ import annotations
 
@@ -26,6 +30,7 @@ import time
 from typing import Callable, Optional, Tuple, Type, Union
 
 from .. import profiler
+from ..observability.registry import add_global_collector
 
 __all__ = ["RetryPolicy", "RetryError", "retry_counters",
            "reset_retry_counters", "DEFAULT_RETRYABLE"]
@@ -55,6 +60,38 @@ def retry_counters() -> dict:
 def reset_retry_counters() -> None:
     with _counters_lock:
         _counters.clear()
+
+
+def _collect_retry_metrics(reg) -> None:
+    """Scrape-time mirror of `_counters` into the metrics registry.
+    Registered as a global collector so it follows default-registry
+    swaps. After a reset_retry_counters() the exposed series DROP to
+    the new totals (Counter.set_total passes decreases through) —
+    Prometheus rate()/increase() read that as a counter reset, which
+    is the correct signal."""
+    counters = retry_counters()
+    if not counters:
+        return
+    families = {
+        "calls": reg.counter(
+            "paddle_tpu_retry_calls_total",
+            "Operations executed under a RetryPolicy, by op name.",
+            ("op",)),
+        "retries": reg.counter(
+            "paddle_tpu_retry_retries_total",
+            "Retry attempts taken (one backoff sleep each), by op name.",
+            ("op",)),
+        "failures": reg.counter(
+            "paddle_tpu_retry_failures_total",
+            "Operations that failed terminally (non-retryable, attempts "
+            "exhausted, or deadline exceeded), by op name.", ("op",)),
+    }
+    for op, c in counters.items():
+        for key, fam in families.items():
+            fam.labels(op=op).set_total(c[key])
+
+
+add_global_collector(_collect_retry_metrics)
 
 
 class RetryError(RuntimeError):

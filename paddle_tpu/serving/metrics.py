@@ -1,96 +1,42 @@
-"""Serving metrics: thread-safe counters/gauges/histograms plus a
-`stats()` JSON snapshot.
+"""Serving metrics: a thin facade over the process-wide observability
+MetricsRegistry.
 
-Design notes: histograms keep a bounded reservoir (most-recent window)
-so percentiles track current behaviour and memory stays O(window) under
-sustained traffic. Host-side timing additionally flows through
-`profiler.RecordEvent(..., cat=profiler.CAT_SERVING)` in the engine, so
-a chrome trace of a live server separates queueing/batching from model
-time (the serving analog of the reference's RecordEvent tables)."""
+PR 1 gave serving its own Counter/Gauge/Histogram classes; those now
+live in ``observability/registry.py`` (same record/snapshot API,
+percentiles corrected to nearest-rank — see Histogram's boundary
+contract there) and are re-exported here for compatibility. Each
+ServingMetrics instance claims one ``engine="<n>"`` label in the shared
+``paddle_tpu_serving_*`` families, so a single ``/metrics`` scrape
+shows every live engine while ``stats()`` keeps its PR-1 JSON shape —
+existing dashboards and tests are unchanged.
+
+Host-side timing additionally flows through
+``profiler.RecordEvent(..., cat=profiler.CAT_SERVING)`` in the engine,
+so a chrome trace of a live server separates queueing/batching from
+model time (the serving analog of the reference's RecordEvent tables).
+"""
 from __future__ import annotations
 
-import collections
+import itertools
 import json
-import threading
-from typing import Deque, Dict, Optional
+from typing import Dict, Optional
 
-import numpy as np
+# re-exported for compatibility with PR-1 call sites that constructed
+# standalone instruments
+from ..observability.registry import (Counter, Gauge,  # noqa: F401
+                                      Histogram, MetricsRegistry,
+                                      default_registry)
 
+__all__ = ["ServingMetrics", "Counter", "Gauge", "Histogram"]
 
-class Counter:
-    """Monotonic counter."""
-
-    def __init__(self):
-        self._v = 0
-        self._lock = threading.Lock()
-
-    def inc(self, n: int = 1):
-        with self._lock:
-            self._v += n
-
-    @property
-    def value(self) -> int:
-        return self._v
-
-
-class Gauge:
-    """Last-set value (e.g. queue depth sampled at submit time)."""
-
-    def __init__(self):
-        self._v = 0.0
-
-    def set(self, v: float):
-        self._v = float(v)
-
-    @property
-    def value(self) -> float:
-        return self._v
-
-
-class Histogram:
-    """Bounded-reservoir histogram: records the most recent `window`
-    observations and answers percentile queries over them."""
-
-    def __init__(self, window: int = 8192):
-        self._vals: Deque[float] = collections.deque(maxlen=window)
-        self._count = 0
-        self._sum = 0.0
-        self._lock = threading.Lock()
-
-    def record(self, v: float):
-        with self._lock:
-            self._vals.append(float(v))
-            self._count += 1
-            self._sum += float(v)
-
-    @property
-    def count(self) -> int:
-        return self._count
-
-    @property
-    def mean(self) -> float:
-        return self._sum / self._count if self._count else 0.0
-
-    def percentile(self, p: float) -> float:
-        with self._lock:
-            if not self._vals:
-                return 0.0
-            return float(np.percentile(np.asarray(self._vals), p))
-
-    def snapshot(self) -> Dict[str, float]:
-        with self._lock:
-            vals = np.asarray(self._vals) if self._vals else None
-        if vals is None:
-            return {"count": self._count, "mean": 0.0,
-                    "p50": 0.0, "p90": 0.0, "p99": 0.0}
-        p50, p90, p99 = np.percentile(vals, [50, 90, 99])
-        return {"count": self._count, "mean": round(self.mean, 6),
-                "p50": round(float(p50), 6), "p90": round(float(p90), 6),
-                "p99": round(float(p99), 6)}
+#: monotonically assigned `engine` label values — one per
+#: ServingMetrics instance, process-wide
+_engine_ids = itertools.count()
 
 
 class ServingMetrics:
-    """All serving-side observability in one place.
+    """All serving-side observability in one place, published to the
+    registry under ``paddle_tpu_serving_*{engine="<n>"}``.
 
     - requests/rejections/timeouts/errors: request-level counters
       (breaker-shed requests are counted by the CircuitBreaker itself
@@ -104,22 +50,58 @@ class ServingMetrics:
       (`Executor.cache_stats`) at snapshot time
     """
 
-    def __init__(self):
-        self.requests = Counter()
-        self.rejected = Counter()
-        self.timeouts = Counter()
-        self.errors = Counter()
-        self.batches = Counter()
-        self.warmup_compiles = Counter()
-        self.queue_depth = Gauge()
-        self.batch_fill_ratio = Histogram()
-        self.batch_rows = Histogram()
-        self.latency_s = Histogram()
-        self.queue_wait_s = Histogram()
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        reg = registry if registry is not None else default_registry()
+        self.registry = reg
+        self.engine_label = str(next(_engine_ids))
+        lab = {"engine": self.engine_label}
+
+        def counter(name, help):
+            return reg.counter(name, help, ("engine",)).labels(**lab)
+
+        def histogram(name, help):
+            return reg.histogram(name, help, ("engine",)).labels(**lab)
+
+        self.requests = counter(
+            "paddle_tpu_serving_requests_total",
+            "Requests accepted by the dynamic batcher.")
+        self.rejected = counter(
+            "paddle_tpu_serving_rejected_total",
+            "Requests rejected by queue backpressure (QueueFullError).")
+        self.timeouts = counter(
+            "paddle_tpu_serving_timeouts_total",
+            "Requests that expired in the queue before being batched.")
+        self.errors = counter(
+            "paddle_tpu_serving_errors_total",
+            "Requests failed by a batch dispatch/delivery error.")
+        self.batches = counter(
+            "paddle_tpu_serving_batches_total",
+            "Batches flushed by the dynamic batcher.")
+        self.warmup_compiles = counter(
+            "paddle_tpu_serving_warmup_compiles_total",
+            "Executables compiled during engine warmup.")
+        self.queue_depth = reg.gauge(
+            "paddle_tpu_serving_queue_depth_rows",
+            "Rows waiting in the dynamic batcher queue (sampled on "
+            "every submit/flush).", ("engine",)).labels(**lab)
+        self.batch_fill_ratio = histogram(
+            "paddle_tpu_serving_batch_fill_ratio",
+            "Real rows / padded bucket rows per flushed batch "
+            "(1.0 = no padding waste).")
+        self.batch_rows = histogram(
+            "paddle_tpu_serving_batch_rows",
+            "Real (unpadded) rows per flushed batch.")
+        self.latency_s = histogram(
+            "paddle_tpu_serving_latency_seconds",
+            "Request wall time, submit to result delivery.")
+        self.queue_wait_s = histogram(
+            "paddle_tpu_serving_queue_wait_seconds",
+            "Request wall time, submit to batch dispatch.")
 
     def stats(self, executor=None) -> Dict:
         """JSON-able snapshot; pass the engine's Executor to fold in
-        compile-cache hit/miss counters."""
+        compile-cache hit/miss counters. (Shape unchanged since PR 1 —
+        this is the facade contract.)"""
         out = {
             "requests": self.requests.value,
             "rejected": self.rejected.value,

@@ -9,6 +9,7 @@ checkpointing (distributed/checkpoint.py), and test() evaluation live
 here on the host."""
 from __future__ import annotations
 
+import contextlib
 import time
 from collections import deque
 from typing import Callable, Dict, Iterable, List, Optional, Sequence
@@ -188,7 +189,20 @@ class Trainer:
 
         Checkpoint saves insert a device sync barrier first
         (Executor.synchronize), so a snapshot can never tear across an
-        in-flight step."""
+        in-flight step.
+
+        Observability (skipped entirely while the default
+        MetricsRegistry is disabled — the process kill switch): each
+        dispatch runs under a StepTrace root span (profiler events
+        emitted inside — feed assembly, dispatch, RPC attempts — share
+        one trace id per step), and the loop publishes
+        paddle_tpu_train_steps_total / _step_seconds / _prefetch_depth
+        to the metrics registry. step_seconds is host-side
+        dispatch-to-dispatch wall time per batch: with async dispatch
+        it measures sustained throughput, not device latency."""
+        from .observability import trace as obs_trace
+        from .observability.registry import default_registry
+
         if not self._started:
             self.start()
         handler = event_handler or (lambda e: None)
@@ -212,6 +226,21 @@ class Trainer:
                 "prefetch and steps_per_dispatch > 1 are mutually "
                 "exclusive: stacking K batches needs host-side ndarray "
                 "feeds, but the prefetcher uploads each batch to device")
+        reg = default_registry()
+        obs_on = reg.enabled
+        if obs_on:
+            m_steps = reg.counter(
+                "paddle_tpu_train_steps_total",
+                "Training steps (batches) dispatched by Trainer.train.")
+            m_step_s = reg.histogram(
+                "paddle_tpu_train_step_seconds",
+                "Host-side wall time per training step "
+                "(dispatch-to-dispatch / batches per dispatch; under "
+                "async dispatch this is throughput, not device latency).")
+            reg.gauge(
+                "paddle_tpu_train_prefetch_depth",
+                "FeedPrefetcher depth of the current train() call "
+                "(0 = inline feed assembly).").set(prefetch)
 
         def _stackable(feeds):
             if len(feeds) < 2:
@@ -268,69 +297,94 @@ class Trainer:
                         yield batch
 
                 feed_iter = _inline_feeds()
+            t_prev = time.monotonic()
             try:
                 while True:
-                    group = []
-                    for _ in range(k):
-                        try:
-                            feed = next(feed_iter)
-                            if k > 1:
-                                # accumulating K batches: snapshot
-                                # ndarray feeds NOW — readers like
-                                # multiprocess_batch_reader hand out
-                                # shared-memory views the producer
-                                # reuses once the consumer advances
-                                feed = {n: (np.array(v) if
-                                            isinstance(v, np.ndarray)
-                                            else v)
-                                        for n, v in feed.items()}
-                            group.append(feed)
-                        except StopIteration:
+                    # one StepTrace root span per dispatch: feed
+                    # assembly, the dispatch itself, and any RPCs the
+                    # handler issues all share this step's trace id.
+                    # Gated with the metrics on the SAME toggle so a
+                    # disabled registry is a full telemetry kill
+                    # switch — and the overhead benchmark's "off" arm
+                    # really is the uninstrumented loop.
+                    with (obs_trace.step_trace(self.step) if obs_on
+                          else contextlib.nullcontext()) as root:
+                        group = []
+                        for _ in range(k):
+                            try:
+                                feed = next(feed_iter)
+                                if k > 1:
+                                    # accumulating K batches: snapshot
+                                    # ndarray feeds NOW — readers like
+                                    # multiprocess_batch_reader hand
+                                    # out shared-memory views the
+                                    # producer reuses once the
+                                    # consumer advances
+                                    feed = {n: (np.array(v) if
+                                                isinstance(v, np.ndarray)
+                                                else v)
+                                            for n, v in feed.items()}
+                                group.append(feed)
+                            except StopIteration:
+                                break
+                        if not group:
+                            # nothing dispatched: the span covered only
+                            # the reader-exhaustion check, so drop its
+                            # trace event rather than reporting a
+                            # phantom N+1th step per pass
+                            if root is not None:
+                                root.discard()
                             break
-                    if not group:
-                        break
-                    handler(BeginIteration(pass_id, dispatch_id))
-                    stacked = _stackable(group) if len(group) == k and \
-                        k > 1 else None
-                    if stacked is not None:
-                        res = self.exe.run(self.main_program,
-                                           feed=stacked,
-                                           fetch_list=fetch_list,
-                                           iterations=k,
-                                           stacked_feed=True, sync=False)
-                    else:
-                        for i, feed in enumerate(group):
+                        handler(BeginIteration(pass_id, dispatch_id))
+                        stacked = _stackable(group) if len(group) == k \
+                            and k > 1 else None
+                        if stacked is not None:
                             res = self.exe.run(self.main_program,
-                                               feed=feed,
+                                               feed=stacked,
                                                fetch_list=fetch_list,
+                                               iterations=k,
+                                               stacked_feed=True,
                                                sync=False)
-                            if i < len(group) - 1:
-                                # non-stackable k>1 fallback: only the
-                                # FINAL batch's result feeds the event/
-                                # cost plumbing, so materialize the
-                                # intermediates here — fetch-time
-                                # checks (NaN/Inf) must cover every
-                                # batch, as the sync loop did
-                                res.fetches()
-                    pending.append(res)
-                    self.step += len(group)
-                    logged = (dispatch_id + 1) % log_every == 0
-                    ev = EndIteration(pass_id, dispatch_id, result=res,
-                                      metric_names=fetch_names)
-                    if logged:
-                        ev.cost  # materialize: the periodic sync point
-                    handler(ev)
-                    # logged dispatches flush everything in flight;
-                    # others keep at most log_every results pending —
-                    # but a checkpoint crossing drains fully first, so
-                    # fetch-time checks (CHECK_NAN_INF) raise BEFORE a
-                    # poisoned snapshot can publish as the newest
-                    # resume point
-                    if logged or self._checkpoint_due(len(group)):
-                        _drain(0)
-                    else:
-                        _drain(log_every)
-                    self._maybe_checkpoint(advanced=len(group))
+                        else:
+                            for i, feed in enumerate(group):
+                                res = self.exe.run(self.main_program,
+                                                   feed=feed,
+                                                   fetch_list=fetch_list,
+                                                   sync=False)
+                                if i < len(group) - 1:
+                                    # non-stackable k>1 fallback: only
+                                    # the FINAL batch's result feeds
+                                    # the event/cost plumbing, so
+                                    # materialize the intermediates
+                                    # here — fetch-time checks
+                                    # (NaN/Inf) must cover every
+                                    # batch, as the sync loop did
+                                    res.fetches()
+                        pending.append(res)
+                        self.step += len(group)
+                        logged = (dispatch_id + 1) % log_every == 0
+                        ev = EndIteration(pass_id, dispatch_id,
+                                          result=res,
+                                          metric_names=fetch_names)
+                        if logged:
+                            ev.cost  # materialize: periodic sync point
+                        handler(ev)
+                        # logged dispatches flush everything in flight;
+                        # others keep at most log_every results pending
+                        # — but a checkpoint crossing drains fully
+                        # first, so fetch-time checks (CHECK_NAN_INF)
+                        # raise BEFORE a poisoned snapshot can publish
+                        # as the newest resume point
+                        if logged or self._checkpoint_due(len(group)):
+                            _drain(0)
+                        else:
+                            _drain(log_every)
+                        self._maybe_checkpoint(advanced=len(group))
+                    if obs_on:
+                        now = time.monotonic()
+                        m_steps.inc(len(group))
+                        m_step_s.record((now - t_prev) / len(group))
+                        t_prev = now
                     dispatch_id += 1
                     if len(group) < k:
                         break
@@ -368,6 +422,12 @@ class Trainer:
                 # valid checkpoint stays the resume point
                 self.checkpoint_failures += 1
                 self.last_checkpoint_error = e
+                from .observability.registry import default_registry
+                default_registry().counter(
+                    "paddle_tpu_train_checkpoint_failures_total",
+                    "Checkpoint saves that failed after retries "
+                    "(training continued; previous checkpoint remains "
+                    "the resume point).").inc()
                 if cc.on_error == "raise":
                     raise
                 import warnings

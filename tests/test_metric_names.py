@@ -1,0 +1,76 @@
+"""Metric-name lint: after a smoke train + serve run, every family in
+the process-wide registry must match the paddle_tpu_* naming contract
+and carry help text. This is the drift guard for later PRs — a producer
+that invents an off-namespace or undocumented metric fails here, not in
+some dashboard six PRs later."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers, serving
+from paddle_tpu.observability import default_registry
+from paddle_tpu.observability.registry import METRIC_NAME_RE
+from paddle_tpu.trainer import Trainer
+
+
+def _smoke_train_and_serve(tmp_path):
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = 0
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        label = layers.data("label", [1])
+        pred = layers.fc(x, size=2)
+        loss = layers.mean(layers.square(pred - label))
+        pt.optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
+    trainer = Trainer(loss, main_program=main, startup_program=startup)
+
+    def reader():
+        rng = np.random.RandomState(1)
+        for _ in range(3):
+            yield {"x": rng.rand(2, 4).astype(np.float32),
+                   "label": rng.rand(2, 1).astype(np.float32)}
+
+    trainer.train(num_passes=1, reader=reader)
+    pt.io.save_inference_model(str(tmp_path), ["x"], [pred], trainer.exe,
+                               main_program=main)
+    model = serving.load(str(tmp_path))
+    engine = model.serve(serving.BatchingConfig(max_batch_size=2,
+                                                max_latency_ms=1.0))
+    engine.start(warmup=False)
+    try:
+        engine.predict({"x": np.zeros((1, 4), np.float32)}, timeout=30)
+    finally:
+        engine.stop()
+
+
+def test_registry_names_and_help_after_smoke_run(tmp_path):
+    _smoke_train_and_serve(tmp_path)
+    reg = default_registry()
+    # families() runs the collectors, so pull-model producers (retry
+    # counters, breaker state) materialize their families too
+    fams = reg.families()
+    # the smoke run must actually have populated the registry
+    names = {f.name for f in fams}
+    for expected in ("paddle_tpu_train_steps_total",
+                     "paddle_tpu_train_step_seconds",
+                     "paddle_tpu_compile_cache_misses_total",
+                     "paddle_tpu_serving_requests_total",
+                     "paddle_tpu_circuit_breaker_state"):
+        assert expected in names, f"smoke run did not publish {expected}"
+    for fam in fams:
+        assert METRIC_NAME_RE.match(fam.name), (
+            f"metric {fam.name!r} violates the naming contract "
+            f"{METRIC_NAME_RE.pattern!r}")
+        assert fam.help and fam.help.strip(), \
+            f"metric {fam.name!r} has no help text"
+        assert fam.exposition_type in ("counter", "gauge", "summary")
+
+
+def test_registry_rejects_offnamespace_names():
+    reg = default_registry()
+    for bad in ("serving_requests_total", "paddle_tpu_Bad",
+                "paddle_tpu_", "paddle_tpu_bad-name"):
+        try:
+            reg.counter(bad, "help")
+        except ValueError:
+            continue
+        raise AssertionError(f"registry accepted bad name {bad!r}")
