@@ -36,6 +36,7 @@ from .reader import batch  # noqa
 from . import concurrency  # noqa
 from . import amp  # noqa
 from . import observability  # noqa  (metrics registry, step tracing, telemetry endpoint)
+from . import analysis  # noqa  (static ProgramDesc verifier, lint passes, pre-compile gate)
 from . import resilience  # noqa  (fault injection, retry/backoff, circuit breaker)
 from . import serving  # noqa  (inference server: dynamic batching + bucketed compile cache)
 

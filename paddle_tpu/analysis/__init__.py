@@ -1,0 +1,25 @@
+"""Static analysis over ProgramDesc-level IR: a pass-based verifier and
+the pre-compile safety gates built on it.
+
+    report = analysis.verify_program(main, startup=startup,
+                                     feed_names=["x"],
+                                     fetch_names=[loss.name])
+    print(report.render_text())
+    report.raise_if_errors()
+
+See ``analysis.verifier`` for gate wiring (executor / serving /
+trainer / io) and ``analysis.passes`` for the individual checks.
+"""
+from .diagnostics import (Diagnostic, Severity, VerificationError,  # noqa
+                          VerifyReport)
+from .passes import (AnalysisPass, PASS_REGISTRY, PassContext,  # noqa
+                     default_passes, register_pass)
+from .verifier import (ProgramVerifier, clear_gate_cache,  # noqa
+                       executor_gate, verify_enabled, verify_program)
+
+__all__ = [
+    "Diagnostic", "Severity", "VerificationError", "VerifyReport",
+    "AnalysisPass", "PASS_REGISTRY", "PassContext", "default_passes",
+    "register_pass", "ProgramVerifier", "verify_program",
+    "verify_enabled", "executor_gate", "clear_gate_cache",
+]

@@ -15,7 +15,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
-from .ir import BlockDesc, OpDesc, Program, VarDesc
+from .ir import BlockDesc, OpDesc, Program, SUB_BLOCK_ATTRS, VarDesc
 from .registry import GRAD_SUFFIX, OpRegistry, grad_var_name
 
 _FLOAT_DTYPES = ("float16", "bfloat16", "float32", "float64")
@@ -71,7 +71,7 @@ class _GradAccumulator:
                                   lod_level=fwd.lod_level)
 
 
-_SUB_BLOCK_ATTRS = ("sub_block_idx", "true_block_idx", "false_block_idx")
+_SUB_BLOCK_ATTRS = SUB_BLOCK_ATTRS
 
 
 def _sub_block_free_vars(op: OpDesc, block: BlockDesc) -> List[str]:

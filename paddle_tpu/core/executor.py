@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from ..amp import amp_enabled
 from .. import profiler
 from ..observability.registry import default_registry
-from .ir import Program, BlockDesc, OpDesc
+from .ir import Program, BlockDesc, OpDesc, SUB_BLOCK_ATTRS
 from .lod import LoDTensor, RaggedNested, RaggedPair, RaggedTree
 from .registry import OpRegistry, run_op
 from .scope import Scope, global_scope
@@ -323,8 +323,7 @@ def _collect_state_names(program: Program, block: BlockDesc,
                 if v is not None and v.persistable and name not in seen_w:
                     seen_w.add(name)
                     writes.append(name)
-            for attr in ("sub_block", "sub_block_idx", "true_block_idx",
-                         "false_block_idx"):
+            for attr in SUB_BLOCK_ATTRS:
                 idx = op.attrs.get(attr)
                 if isinstance(idx, int) and 0 <= idx < len(program.blocks):
                     visit(program.blocks[idx])
@@ -440,8 +439,7 @@ def _stateful_ops_in(program: Program, ops) -> List[str]:
         for op in op_list:
             if OpRegistry.has(op.type) and OpRegistry.get(op.type).stateful:
                 found.append(op.type)
-            for attr in ("sub_block", "sub_block_idx", "true_block_idx",
-                         "false_block_idx"):
+            for attr in SUB_BLOCK_ATTRS:
                 idx = op.attrs.get(attr)
                 if isinstance(idx, int) and 0 <= idx < len(program.blocks):
                     visit(program.blocks[idx].ops)
@@ -770,6 +768,19 @@ class Executor:
                      if op.type == "while"
                      and op.outputs.get("Exhausted")]
         fetch_names = fetch_names + exhausted
+
+        # Pre-compile safety gate: structural verification (def-use,
+        # build-time shape markers, dead code, donation hazards) BEFORE
+        # any trace or XLA compile, so a malformed program raises a
+        # VerificationError (a ValueError) naming the op and block path
+        # instead of a deep JAX trace error. Memoized per program
+        # version, so steady-state dispatch pays one dict lookup;
+        # PADDLE_TPU_VERIFY=0 opts out.
+        from ..analysis import verifier as _verifier
+        if _verifier.verify_enabled():
+            _verifier.executor_gate(program, block_idx,
+                                    fetch_names[:n_user_fetches],
+                                    feed.keys(), self.donate_state, sync)
 
         feed_vals = {k: _to_device_value(v) for k, v in feed.items()}
         feed_sig = feed_signature(feed_vals)

@@ -14,6 +14,14 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+# Op attrs naming a sub-block the executor descends into. THE canonical
+# list — the executor's state/stateful walks, backward's closure-grad
+# detection, the memory-optimization transpiler, and the static
+# verifier all traverse the block tree through these names; adding a
+# new control-flow sub-block attr means adding it HERE.
+SUB_BLOCK_ATTRS = ("sub_block", "sub_block_idx", "true_block_idx",
+                   "false_block_idx")
+
 # Variable types (reference: framework.proto VarType, framework.proto:85-120).
 VAR_TYPE_LOD_TENSOR = "lod_tensor"
 VAR_TYPE_SELECTED_ROWS = "selected_rows"

@@ -36,9 +36,14 @@ class OpDef:
         self.ragged_aware = ragged_aware
         # compute(ctx) -> None; reads ctx.input/attr, writes ctx.set_output.
         self.compute = compute
-        # infer_shape(block, op) -> None; fills output VarDesc shapes/dtypes at
-        # build time (reference: shape_inference.h:28). Optional: the JAX trace
-        # is the authoritative shape check at compile time.
+        # infer_shape(block_desc, op) -> {name: {"shape", "dtype",
+        # "lod_level"}} (reference: shape_inference.h:28): PURE — returns
+        # output specs, never mutates the block. The builder applies them
+        # (framework._apply_inferred, filling only undeclared fields) and
+        # the static verifier compares them against declarations. Only
+        # needed for ops the generic eval_shape trace cannot cover
+        # (control-flow family); the JAX trace stays the authoritative
+        # shape check at compile time.
         self.infer_shape = infer_shape
         # grad_maker(op, block, grad_sub_block) -> List[OpDesc]
         self.grad_maker = grad_maker

@@ -40,14 +40,47 @@ def _dot_escape(s: str) -> str:
     return s.replace('"', '\\"')
 
 
+#: graphviz fill colors by highest diagnostic severity on an op node
+_DIAG_COLORS = {2: "tomato", 1: "gold"}   # error=red, warning=yellow
+
+
+def _diag_index(diagnostics, block_idx: int):
+    """{op_index: (max_severity, [codes])} for diagnostics anchored in
+    the drawn block. Accepts an analysis.VerifyReport or any iterable
+    of Diagnostic objects."""
+    diags = getattr(diagnostics, "diagnostics", diagnostics) or ()
+    index = {}
+    for d in diags:
+        if d.op_index is None or d.block_path[-1] != block_idx:
+            continue
+        sev = int(d.severity)
+        prev = index.get(d.op_index)
+        if prev is None:
+            index[d.op_index] = (sev, [d.code])
+        else:
+            psev, codes = prev
+            if d.code not in codes:
+                codes.append(d.code)
+            index[d.op_index] = (max(psev, sev), codes)
+    return index
+
+
 def draw_graph(program, path: Optional[str] = None,
-               block_idx: int = 0) -> str:
+               block_idx: int = 0, diagnostics=None) -> str:
     """Emit Graphviz DOT for one block's op/var graph (reference:
     net_drawer.py draw_graph / graphviz.py). Ops are boxes, variables are
     ellipses (parameters shaded); edges follow dataflow. Returns the DOT
-    source; writes it to `path` when given."""
+    source; writes it to `path` when given.
+
+    `diagnostics` (an ``analysis.VerifyReport`` or list of
+    ``Diagnostic``) colors op nodes by their worst finding — error ops
+    red, warning ops yellow — with the diagnostic codes appended to the
+    node label, so verifier output is visually attributable to the
+    graph position it names."""
     desc = program.desc if hasattr(program, "desc") else program
     block = desc.blocks[block_idx]
+    diag_idx = _diag_index(diagnostics, block_idx) if diagnostics \
+        is not None else {}
     out = ["digraph G {", "  rankdir=TB;"]
     seen_vars = set()
 
@@ -65,8 +98,15 @@ def draw_graph(program, path: Optional[str] = None,
                    f'shape=ellipse{style}];')
 
     for i, op in enumerate(block.ops):
-        out.append(f'  "op_{i}" [label="{_dot_escape(op.type)}" '
-                   'shape=box style=filled fillcolor="lightgray"];')
+        label = _dot_escape(op.type)
+        color = "lightgray"
+        hit = diag_idx.get(i)
+        if hit is not None:
+            sev, codes = hit
+            color = _DIAG_COLORS.get(sev, color)
+            label += "\\n" + _dot_escape(", ".join(codes))
+        out.append(f'  "op_{i}" [label="{label}" '
+                   f'shape=box style=filled fillcolor="{color}"];')
         for names in op.inputs.values():
             for n in names:
                 var_node(n)

@@ -51,6 +51,14 @@ FLAGS: Dict[str, tuple] = {
         "flag instead (per-run host readback; the `<name>.exhausted` "
         "bool var is always available to fetch; loops nested in "
         "sub-blocks keep their flag block-local)"),
+    "PADDLE_TPU_VERIFY": (
+        "1", "analysis/verifier.py (gates in core/executor.py, "
+        "serving/model.py, trainer.py, io.py)",
+        "static program verification gates: pre-compile (executor "
+        "cache miss), serving model load, trainer setup, and "
+        "save_inference_model all raise VerificationError on "
+        "error-severity diagnostics; 0 disables every gate (the "
+        "executor trace remains the runtime authority)"),
     "PADDLE_TPU_DATA_HOME": (
         "~/.cache/paddle_tpu/dataset", "dataset/common.py",
         "dataset download/cache directory"),

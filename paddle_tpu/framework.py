@@ -197,17 +197,133 @@ _DUMMY_BATCH = 97
 _DUMMY_TIME = 13
 _DUMMY_SUB = 7
 
+#: OpDesc attr recording that shape inference could not cover this op
+#: (and why). Written by `_infer_shapes`, read by the static verifier's
+#: coverage report (analysis/passes.py) and by tools/lint_ir.py.
+SHAPE_INFER_SKIPPED_ATTR = "__shape_infer_skipped__"
+#: OpDesc attr recording declared-vs-inferred conflicts found at build
+#: time (list of dicts, see analysis.passes.ShapeDtypePass.compare) —
+#: what the executor's cheap (no-retrace) pre-compile gate reads.
+SHAPE_INFER_CONFLICT_ATTR = "__shape_infer_conflict__"
+
 
 def _infer_shapes(block_desc: ir.BlockDesc, op: ir.OpDesc) -> None:
+    """Fill output VarDesc shapes/dtypes for a just-appended op.
+
+    Tries the generic eval_shape trace first; when that cannot run, an
+    explicit per-op rule registered on the OpDef (`infer_shape`) gets a
+    chance. An op covered by neither is RECORDED on the OpDesc
+    (`SHAPE_INFER_SKIPPED_ATTR` = reason) instead of silently
+    propagating unknown shapes — the verifier reports these as coverage
+    gaps. The executor's trace remains the authoritative shape check.
+    """
     try:
-        _infer_shapes_impl(block_desc, op)
+        outs, skip = infer_op_outputs(block_desc, op)
+        if outs is not None:
+            op.attrs.pop(SHAPE_INFER_SKIPPED_ATTR, None)
+            _apply_inferred(block_desc, op, outs)
+            return
+        opdef = OpRegistry.get(op.type) if OpRegistry.has(op.type) \
+            else None
+        rule = getattr(opdef, "infer_shape", None)
+        if rule is not None:
+            try:
+                explicit = rule(block_desc, op)
+                if explicit:
+                    _apply_inferred(block_desc, op, explicit)
+                # "covered" only if every output actually ended up with
+                # metadata — a rule that resolves just some outputs
+                # (e.g. only the scalar flags) must not swallow the gap
+                # for the rest
+                unresolved = unresolved_outputs(
+                    block_desc, op, covered=explicit or ())
+                if unresolved:
+                    op.attrs[SHAPE_INFER_SKIPPED_ATTR] = \
+                        RULE_UNRESOLVED_PREFIX + str(unresolved[:3])
+                else:
+                    op.attrs.pop(SHAPE_INFER_SKIPPED_ATTR, None)
+                return
+            except Exception as e:
+                skip = f"explicit rule failed: {type(e).__name__}"
+        op.attrs[SHAPE_INFER_SKIPPED_ATTR] = str(skip)[:200]
     except Exception:
-        # Inference is best-effort at build time; the executor's trace is
-        # the authoritative shape check.
+        # Inference (and the marker bookkeeping around it) is
+        # best-effort at build time; the executor's trace is the
+        # authoritative shape check.
         pass
 
 
-def _infer_shapes_impl(block_desc: ir.BlockDesc, op: ir.OpDesc) -> None:
+#: skip-reason prefix shared by framework and the verifier's coverage
+#: reporting (analysis.passes matches on "explicit rule")
+RULE_UNRESOLVED_PREFIX = "explicit rule left outputs unresolved: "
+
+
+def unresolved_outputs(block_desc: ir.BlockDesc, op: ir.OpDesc,
+                       covered=()) -> List[str]:
+    """Output names still lacking declared shape OR dtype, minus names
+    in ``covered`` (specs an explicit rule provided). The one
+    definition of 'this op's outputs are not fully resolved', shared by
+    build-time marker stamping and the verifier's retrace path."""
+    out = []
+    for n in op.output_names():
+        if n in covered:
+            continue
+        v = block_desc.find_var_recursive(n)
+        if v is not None and (v.shape is None or v.dtype is None):
+            out.append(n)
+    return out
+
+
+def _apply_inferred(block_desc: ir.BlockDesc, op: ir.OpDesc,
+                    outs: Dict[str, Dict]) -> None:
+    """Write inferred {name: {shape, dtype, lod_level}} onto VarDescs,
+    filling only what the builder left unknown. Where an EXPLICIT
+    declaration disagrees with the inferred result, the conflict is
+    stamped onto the op (`SHAPE_INFER_CONFLICT_ATTR`) for the
+    verifier's cheap no-retrace mode — the builder itself stays
+    permissive, preserving the executor trace as the runtime authority.
+    """
+    from .analysis.passes import ShapeDtypePass  # no import cycle: lazy
+    conflicts = []
+    for name, spec in outs.items():
+        v = block_desc.find_var_recursive(name)
+        if v is None:
+            continue
+        conflicts.extend(ShapeDtypePass.compare(name, v, spec))
+        if v.shape is None and spec.get("shape") is not None:
+            v.shape = list(spec["shape"])
+        if spec.get("lod_level"):
+            v.lod_level = max(v.lod_level, spec["lod_level"])
+        if v.dtype is None and spec.get("dtype") is not None:
+            v.dtype = spec["dtype"]
+    if conflicts:
+        op.attrs[SHAPE_INFER_CONFLICT_ATTR] = conflicts
+    else:
+        op.attrs.pop(SHAPE_INFER_CONFLICT_ATTR, None)
+
+
+def infer_op_outputs(block_desc: ir.BlockDesc, op: ir.OpDesc):
+    """Abstractly evaluate one op's compute rule: ``(outputs, skip)``.
+
+    ``outputs`` is {name: {"shape": [...]|None, "dtype": str,
+    "lod_level": int}} with dummy extents mapped back to -1, or None
+    when inference could not run — then ``skip`` carries the reason.
+    Pure: never mutates the block or its VarDescs, so the static
+    verifier can re-run it to cross-check declared metadata.
+    """
+    try:
+        return _infer_op_outputs_impl(block_desc, op), None
+    except _SkipInference as e:
+        return None, str(e)
+    except Exception as e:
+        return None, f"trace failed: {type(e).__name__}: {e}"
+
+
+class _SkipInference(Exception):
+    """Inference preconditions unmet (unknown input shape/dtype)."""
+
+
+def _infer_op_outputs_impl(block_desc: ir.BlockDesc, op: ir.OpDesc):
     import jax
     import jax.numpy as jnp
     from .core.lod import RaggedNested, RaggedPair, RaggedTree
@@ -219,7 +335,8 @@ def _infer_shapes_impl(block_desc: ir.BlockDesc, op: ir.OpDesc) -> None:
         if v is None or v.shape is None or v.dtype is None:
             if op.type not in ("fill_constant", "uniform_random",
                               "gaussian_random", "assign_value"):
-                return  # can't infer without input shapes
+                raise _SkipInference(
+                    f"input {name!r} has no declared shape/dtype")
             continue
         shape = [(_DUMMY_BATCH if d == -1 else int(d)) for d in v.shape]
         dt = jnp_dtype(v.dtype)
@@ -257,49 +374,40 @@ def _infer_shapes_impl(block_desc: ir.BlockDesc, op: ir.OpDesc) -> None:
         })
 
     outs = jax.eval_shape(run, env)
+    result = {}
     for name, aval in outs.items():
-        v = block_desc.find_var_recursive(name)
-        if v is None:
-            continue
         if isinstance(aval, RaggedTree):
             k = aval.depth
             shape = [(-1 if d in (_DUMMY_BATCH,
                                   _DUMMY_BATCH * _DUMMY_SUB) else int(d))
                      for i, d in enumerate(aval.data.shape)
                      if not (1 <= i <= k)]
-            if v.shape is None:
-                v.shape = shape
-            v.lod_level = max(v.lod_level, k)
-            if v.dtype is None:
-                v.dtype = str(aval.data.dtype)
+            result[name] = {"shape": shape,
+                            "dtype": str(aval.data.dtype),
+                            "lod_level": k}
         elif isinstance(aval, RaggedNested):
             shape = [(-1 if d == _DUMMY_BATCH else int(d))
                      for i, d in enumerate(aval.data.shape)
                      if i not in (1, 2)]
-            if v.shape is None:
-                v.shape = shape
-            v.lod_level = max(v.lod_level, 2)
-            if v.dtype is None:
-                v.dtype = str(aval.data.dtype)
+            result[name] = {"shape": shape,
+                            "dtype": str(aval.data.dtype),
+                            "lod_level": 2}
         elif isinstance(aval, RaggedPair):
             # a ragged batch dim may come from flattening a nested batch
             # (n*max_sub): map any non-static leading dim back to -1
             shape = [(-1 if d in (_DUMMY_BATCH, _DUMMY_BATCH * _DUMMY_SUB)
                       else int(d))
                      for i, d in enumerate(aval.data.shape) if i != 1]
-            if v.shape is None:
-                v.shape = shape
-            v.lod_level = max(v.lod_level, 1)
-            if v.dtype is None:
-                v.dtype = str(aval.data.dtype)
+            result[name] = {"shape": shape,
+                            "dtype": str(aval.data.dtype),
+                            "lod_level": 1}
         else:
             shape = [(-1 if d in (_DUMMY_BATCH, _DUMMY_BATCH * _DUMMY_SUB)
                       else int(d))
                      for d in aval.shape]
-            if v.shape is None:
-                v.shape = shape
-            if v.dtype is None:
-                v.dtype = str(aval.dtype)
+            result[name] = {"shape": shape, "dtype": str(aval.dtype),
+                            "lod_level": 0}
+    return result
 
 
 def _names(slot_map: Optional[Dict]) -> Dict[str, List[str]]:

@@ -111,6 +111,17 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     # inference mode: BN uses running stats, dropout is identity
     # (reference: io.py:259/344 inference_optimize on the pruned program)
     pruned = pruned.inference_optimize()
+    # Verify the frozen artifact BEFORE it reaches disk: a broken
+    # export (fetch pruned away, dangling input after a bad transpile)
+    # should fail the save, not the eventual serving load.
+    # PADDLE_TPU_VERIFY=0 opts out.
+    from .analysis import verify_enabled, verify_program
+    if verify_enabled():
+        verify_program(
+            pruned, feed_names=list(feeded_var_names),
+            fetch_names=fetch_names,
+            program_label="frozen inference program",
+        ).raise_if_errors(context="save_inference_model")
     # The program itself ships as compact PTIR binary written by the native
     # IR library (native/ir.cc), like the reference's protobuf __model__
     # (reference: io.py:298 writes program.desc.serialize_to_string()).

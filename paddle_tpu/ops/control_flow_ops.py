@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 from functools import partial
 
-from ..core.registry import register_op
+from ..core.registry import OpRegistry, register_op
 
 register_op_CF = partial(register_op, ragged_aware=True)
 
@@ -488,3 +488,97 @@ def _go(ctx):
         _host_launch, jax.ShapeDtypeStruct((), jnp.int32), *vals,
         ordered=True)
     ctx.set_output("Status", status)
+
+
+# ---------------------------------------------------------------------------
+# Explicit shape-inference rules for the control-flow family.
+#
+# The generic build-time mechanism (framework.infer_op_outputs) abstractly
+# evaluates an op's compute rule — but these ops trace their SUB-BLOCKS and
+# need extra["program"] plus closure vars, so eval_shape cannot run them and
+# they were the most common "no shape-inference coverage" gaps the static
+# verifier found. The rules below derive output metadata structurally:
+#
+# - while:      Out re-writes already-declared parent carries; the
+#               Exhausted/Steps/NestedSteps flags are scalars.
+# - if_else:    Out[i] mirrors the true branch's i-th output var.
+# - static_rnn: Out[i] = [T, *step_out_shape] (scan stacks the per-step
+#               output over the leading time axis of X).
+# - dynamic_rnn: Out[i] mirrors the sub-block step output (ragged,
+#               lod_level 1); LastMem[i] mirrors the init memory.
+#
+# Rule contract (framework._infer_shapes): rule(block_desc, op) -> dict
+# {name: {"shape", "dtype", "lod_level"}} filling only what the builder
+# left undeclared.
+
+def _scalar_specs(op, slots_dtypes):
+    specs = {}
+    for slot, dtype in slots_dtypes:
+        for n in op.output(slot):
+            specs[n] = {"shape": [], "dtype": dtype, "lod_level": 0}
+    return specs
+
+
+def _sub_var(block_desc, blk_idx, name):
+    prog = block_desc.program
+    if not isinstance(blk_idx, int) or not 0 <= blk_idx < len(prog.blocks):
+        return None
+    return prog.blocks[blk_idx].find_var_recursive(name)
+
+
+def _while_infer(block_desc, op):
+    return _scalar_specs(op, [("Exhausted", "bool"), ("Steps", "int32"),
+                              ("NestedSteps", "int32")])
+
+
+def _if_else_infer(block_desc, op):
+    specs = _scalar_specs(op, [("NestedSteps", "int32")])
+    tb = op.attrs.get("true_block_idx")
+    for out, tn in zip(op.output("Out"),
+                       op.attrs.get("true_out_names") or []):
+        tv = _sub_var(block_desc, tb, tn)
+        if tv is not None and tv.shape is not None:
+            specs[out] = {"shape": list(tv.shape), "dtype": tv.dtype,
+                          "lod_level": tv.lod_level}
+    return specs
+
+
+def _static_rnn_infer(block_desc, op):
+    specs = _scalar_specs(op, [("NestedSteps", "int32")])
+    t_dim = -1
+    for xn in op.input("X"):
+        xv = block_desc.find_var_recursive(xn)
+        if xv is not None and xv.shape:
+            t_dim = xv.shape[0]
+            break
+    blk_idx = op.attrs.get("sub_block_idx")
+    for out, sn in zip(op.output("Out"),
+                       op.attrs.get("out_names") or []):
+        sv = _sub_var(block_desc, blk_idx, sn)
+        if sv is not None and sv.shape is not None:
+            specs[out] = {"shape": [t_dim] + list(sv.shape),
+                          "dtype": sv.dtype, "lod_level": 0}
+    return specs
+
+
+def _dynamic_rnn_infer(block_desc, op):
+    specs = _scalar_specs(op, [("NestedSteps", "int32")])
+    blk_idx = op.attrs.get("sub_block_idx")
+    for out, sn in zip(op.output("Out"),
+                       op.attrs.get("out_names") or []):
+        sv = _sub_var(block_desc, blk_idx, sn)
+        if sv is not None and sv.shape is not None:
+            specs[out] = {"shape": list(sv.shape), "dtype": sv.dtype,
+                          "lod_level": 1}
+    for out, mn in zip(op.output("LastMem"), op.input("MemInit")):
+        mv = block_desc.find_var_recursive(mn)
+        if mv is not None and mv.shape is not None:
+            specs[out] = {"shape": list(mv.shape), "dtype": mv.dtype,
+                          "lod_level": 0}
+    return specs
+
+
+for _t, _rule in (("while", _while_infer), ("if_else", _if_else_infer),
+                  ("static_rnn", _static_rnn_infer),
+                  ("dynamic_rnn", _dynamic_rnn_infer)):
+    OpRegistry.get(_t).infer_shape = _rule

@@ -39,6 +39,7 @@ class ServableModel:
         # host-side batch assembly with the device run).
         self._run_lock = threading.Lock()
         self._check_frozen()
+        self._verify()
 
     # ------------------------------------------------------------------
     @classmethod
@@ -72,6 +73,21 @@ class ServableModel:
                 "program is not frozen for inference — ops write "
                 f"persistable vars: {offenders}; re-export with "
                 "save_inference_model (which prunes the training graph)")
+
+    def _verify(self):
+        """Static verification of the frozen program at load time
+        (full abstract-inference re-trace — a servable is pinned for
+        the life of the server, so a malformed or truncated export
+        must fail HERE, not on the first live request). Honors
+        PADDLE_TPU_VERIFY=0."""
+        from ..analysis import verify_enabled, verify_program
+        if not verify_enabled():
+            return
+        verify_program(
+            self.program, feed_names=self.feed_names,
+            fetch_names=self.fetch_names,
+            program_label="servable program",
+        ).raise_if_errors(context="ServableModel load")
 
     # ------------------------------------------------------------------
     def run_direct(self, feed: Dict[str, Any], sync: bool = True):

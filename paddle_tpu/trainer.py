@@ -126,10 +126,40 @@ class Trainer:
         self.checkpoint_failures = 0
         self.last_checkpoint_error = None
 
+    def _verify_programs(self):
+        """Static verification of the (main, startup) pair, once at
+        setup — the only gate that sees BOTH programs, so it is where
+        uninitialized-persistable detection runs (a param the startup
+        program never writes fails here with the var named, instead of
+        as a scope KeyError mid-trace). Uses the cheap no-retrace shape
+        pass: trainer programs come from the builder, which already
+        stamped coverage/conflict markers. PADDLE_TPU_VERIFY=0 opts
+        out."""
+        from .analysis import verify_enabled, verify_program
+        from .analysis.passes import fast_passes
+        if not verify_enabled():
+            return
+        fetch = [self.loss.name] + [getattr(v, "name", str(v))
+                                    for v in self.fetch_metrics.values()]
+        feeds = [v.name for v in self._feeder.feed_vars] \
+            if self._feeder is not None else None
+        verify_program(
+            self.main_program, startup=self.startup_program,
+            feed_names=feeds, fetch_names=fetch,
+            donate=getattr(self.exe, "donate_state", False),
+            # train() always dispatches sync=False: a donated-fetch
+            # hazard in fetch_metrics must fail HERE, not on the first
+            # step after startup + checkpoint restore already ran
+            async_dispatch=True,
+            passes=fast_passes(with_uninit=True),
+            program_label="trainer main program",
+        ).raise_if_errors(context="Trainer setup")
+
     # -- lifecycle --------------------------------------------------------
     def start(self, resume: bool = True):
         """Run startup (param init), then restore the newest valid
         checkpoint if configured (elastic resume)."""
+        self._verify_programs()
         self.exe.run(self.startup_program)
         if resume and self.checkpoint_config:
             from .distributed.checkpoint import load_checkpoint

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Set
 
+from ..core.ir import SUB_BLOCK_ATTRS
 from ..framework import Program
 
 DEAD_VARS_ATTR = "__dead_vars__"
@@ -70,8 +71,7 @@ def _sub_block_refs(program: Program) -> Set[str]:
         for op in block.ops:
             refs.update(op.input_names())
             refs.update(op.output_names())
-    sub_attrs = ("sub_block", "sub_block_idx", "true_block_idx",
-                 "false_block_idx")
+    sub_attrs = SUB_BLOCK_ATTRS
     for block in program.desc.blocks:
         for op in block.ops:
             if not any(a in op.attrs for a in sub_attrs):

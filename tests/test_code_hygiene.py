@@ -1,0 +1,122 @@
+"""Codebase-level lint: AST-walk every module under paddle_tpu/ and ban
+three defect-prone patterns — the source-level counterpart of the
+metric-name lint from the observability PR (tests/test_metric_names.py):
+
+- bare ``except:`` — swallows KeyboardInterrupt/SystemExit and hides
+  real faults (the resilience layer's retry filters depend on
+  exception types propagating);
+- mutable default arguments — shared across calls, a classic
+  state-leak between Programs/tests;
+- ``lock.acquire()`` outside a ``with`` statement — a raise between
+  acquire and release deadlocks the serving workers / training loop
+  (every lock in the codebase is expected to use context-manager form).
+"""
+import ast
+import os
+
+import pytest
+
+_PKG = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "paddle_tpu")
+
+
+def _py_files():
+    for root, dirs, files in os.walk(_PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def _rel(path):
+    return os.path.relpath(path, os.path.dirname(_PKG))
+
+
+def _parse(path):
+    with open(path, encoding="utf-8") as f:
+        return ast.parse(f.read(), filename=path)
+
+
+def test_no_bare_except():
+    offenders = []
+    for path in _py_files():
+        for node in ast.walk(_parse(path)):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                offenders.append(f"{_rel(path)}:{node.lineno}")
+    assert not offenders, (
+        "bare `except:` swallows KeyboardInterrupt/SystemExit — catch "
+        "Exception (or narrower):\n  " + "\n  ".join(offenders))
+
+
+def test_no_mutable_default_args():
+    offenders = []
+    for path in _py_files():
+        for node in ast.walk(_parse(path)):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            defaults = list(node.args.defaults) + \
+                [d for d in node.args.kw_defaults if d is not None]
+            for d in defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                        isinstance(d, ast.Call)
+                        and isinstance(d.func, ast.Name)
+                        and d.func.id in ("list", "dict", "set")):
+                    name = getattr(node, "name", "<lambda>")
+                    offenders.append(
+                        f"{_rel(path)}:{d.lineno} in {name}()")
+    assert not offenders, (
+        "mutable default arguments are shared across calls — default "
+        "to None and construct inside the function:\n  "
+        + "\n  ".join(offenders))
+
+
+def test_no_lock_acquire_outside_with():
+    """Any ``<expr>.acquire(...)`` call must appear as (part of) a
+    ``with`` item; explicit acquire/release pairs leak the lock when
+    the critical section raises."""
+    offenders = []
+    for path in _py_files():
+        tree = _parse(path)
+        with_calls = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        if isinstance(sub, ast.Call):
+                            with_calls.add(id(sub))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "acquire" \
+                    and id(node) not in with_calls:
+                offenders.append(f"{_rel(path)}:{node.lineno}")
+    assert not offenders, (
+        "lock.acquire() outside a `with` statement — use the lock as a "
+        "context manager so a raise cannot leak it:\n  "
+        + "\n  ".join(offenders))
+
+
+@pytest.mark.parametrize("snippet,expected", [
+    ("try:\n    pass\nexcept:\n    pass\n", "bare"),
+    ("def f(x=[]):\n    return x\n", "mutable"),
+    ("import threading\nl = threading.Lock()\nl.acquire()\n", "acquire"),
+])
+def test_lint_rules_detect_planted_defects(tmp_path, snippet, expected):
+    """The rules themselves catch planted violations (guards against a
+    lint that silently stopped matching anything)."""
+    tree = ast.parse(snippet)
+    if expected == "bare":
+        assert any(isinstance(n, ast.ExceptHandler) and n.type is None
+                   for n in ast.walk(tree))
+    elif expected == "mutable":
+        assert any(isinstance(n, ast.FunctionDef)
+                   and any(isinstance(d, ast.List)
+                           for d in n.args.defaults)
+                   for n in ast.walk(tree))
+    else:
+        assert any(isinstance(n, ast.Call)
+                   and isinstance(n.func, ast.Attribute)
+                   and n.func.attr == "acquire"
+                   for n in ast.walk(tree))

@@ -1,0 +1,96 @@
+"""tools/lint_ir.py: drive the verifier CLI over every named test
+network (keeping the suite's program shapes verifier-clean in CI), over
+a saved inference model dir, and through the broken/exit-code paths."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+import lint_ir  # noqa: E402
+
+
+@pytest.mark.parametrize("name", sorted(lint_ir.NETWORKS))
+def test_every_named_network_lints_clean(name, capsys):
+    """Each network used by the test suite exits 0 (zero errors)."""
+    rc = lint_ir.main(["--network", name])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 error(s)" in out
+
+
+def test_network_fast_mode_lints_clean(capsys):
+    rc = lint_ir.main(["--network", "mnist_mlp", "--no-retrace"])
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_json_output_parses(capsys):
+    rc = lint_ir.main(["--network", "fc_regression", "--json"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is True
+    assert payload["counts"]["error"] == 0
+
+
+def test_list_networks(capsys):
+    assert lint_ir.main(["--list-networks"]) == 0
+    listed = capsys.readouterr().out.split()
+    assert listed == sorted(lint_ir.NETWORKS)
+
+
+def test_model_dir_lints_clean_and_broken_dir_fails(tmp_path, capsys):
+    from paddle_tpu import layers, optimizer
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8])
+        pred = layers.fc(x, size=2, act="softmax")
+        loss = layers.mean(pred)
+        optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    pt.io.save_inference_model(str(tmp_path), ["x"], [pred], exe,
+                               main_program=main)
+    rc = lint_ir.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+
+    # corrupt the frozen program: dangle an input of the first op
+    # (JSON-fallback model file or PTIR binary — rewrite as JSON)
+    prog, feeds, fetch_vars, _ = pt.io.load_inference_model(
+        str(tmp_path), exe, return_meta=True)
+    op = prog.desc.global_block.ops[0]
+    slot = next(iter(op.inputs))
+    op.inputs[slot] = ["@gone@"]
+    meta = dict(prog.desc.to_dict())
+    meta["feed_names"] = feeds
+    meta["fetch_names"] = [v.name for v in fetch_vars]
+    for stale in ("__model__", "__model__.json"):
+        p = os.path.join(str(tmp_path), stale)
+        if os.path.exists(p):
+            os.remove(p)
+    with open(os.path.join(str(tmp_path), "__model__.json"), "w") as f:
+        json.dump(meta, f)
+    rc = lint_ir.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "dangling-input" in out and "@gone@" in out
+
+
+def test_cli_subprocess_entrypoint():
+    """The tool works as an actual command (fresh interpreter)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "lint_ir.py"),
+         "--network", "fc_regression"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "0 error(s)" in res.stdout

@@ -1,0 +1,435 @@
+"""Golden-defect suite for the static ProgramDesc verifier
+(paddle_tpu.analysis): one deliberately broken program per defect
+class, each asserted to be caught STATICALLY (no JAX compile) with the
+right severity and block path — plus a no-false-positive sweep over
+healthy networks, gate-wiring checks (executor / serving / trainer /
+io), the opt-out env toggle, and the diagnostic-colored DOT export."""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import analysis, layers, optimizer
+from paddle_tpu.analysis import Severity, VerificationError
+
+
+def _mnist_mlp():
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = 0
+    with pt.program_guard(main, startup):
+        img = layers.data("img", [784])
+        label = layers.data("label", [1], dtype="int64")
+        h = layers.fc(img, size=16, act="relu")
+        pred = layers.fc(h, size=10, act="softmax")
+        loss = layers.mean(layers.cross_entropy(input=pred, label=label))
+        optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+    return main, startup, loss
+
+
+def _feed(n=4):
+    rng = np.random.RandomState(0)
+    return {"img": rng.rand(n, 784).astype(np.float32),
+            "label": rng.randint(0, 10, (n, 1)).astype(np.int64)}
+
+
+# ---------------------------------------------------------------------------
+# golden defect 1: dangling input (in a While sub-block, to pin the
+# block path)
+# ---------------------------------------------------------------------------
+def test_golden_dangling_input_in_subblock():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        i = layers.fill_constant([1], "int32", 0)
+        n = layers.fill_constant([1], "int32", 3)
+        s = layers.fc(x, size=4)
+        w = layers.While(layers.less_than(i, n), max_steps=8)
+        with w.block():
+            layers.assign(layers.elementwise_add(s, s), s)
+            layers.assign(layers.increment(i, in_place=False), i)
+        out = layers.mean(s)
+    # corrupt the first body op: point one input at a name that no
+    # block in the parent chain declares
+    body = main.desc.blocks[1]
+    bad_op = body.ops[0]
+    slot = next(iter(bad_op.inputs))
+    bad_op.inputs[slot] = ["@no_such_var@"]
+
+    rep = analysis.verify_program(main, feed_names=["x"],
+                                  fetch_names=[out.name])
+    hits = rep.by_code("dangling-input")
+    assert hits, rep.render_text()
+    d = hits[0]
+    assert d.severity == Severity.ERROR
+    assert d.block_path == (0, 1)           # root > while body
+    assert d.op_index == 0
+    assert d.op_type == bad_op.type
+    assert "@no_such_var@" in d.message
+    assert "block 0 > block 1 / op 0" in d.location()
+    assert not rep.ok
+
+
+def test_golden_read_before_write():
+    """A var read at op i whose only writers are LATER ops of the same
+    block (no outside-block producer to excuse a loop carry) reads an
+    undefined value on first execution."""
+    main = pt.Program()
+    blk = main.global_block()
+    blk.create_var("x", shape=[2], dtype="float32")
+    blk.create_var("t", shape=[2], dtype="float32")
+    blk.create_var("o", shape=[2], dtype="float32")
+    blk.append_op("elementwise_add", {"X": "t", "Y": "x"}, {"Out": "o"})
+    blk.append_op("scale", {"X": "x"}, {"Out": "t"}, {"scale": 2.0})
+    rep = analysis.verify_program(main, feed_names=["x"],
+                                  fetch_names=["o"])
+    hits = rep.by_code("read-before-write")
+    assert hits, rep.render_text()
+    assert hits[0].severity == Severity.ERROR
+    assert hits[0].var == "t" and hits[0].op_index == 0
+    # a loop-carry (same-block later write, but ALSO an outside-block
+    # writer) is exercised clean by the while_loop network sweep
+
+
+# ---------------------------------------------------------------------------
+# golden defect 2: dtype clash — and the executor gate catches it
+# BEFORE any compile via the build-time conflict marker
+# ---------------------------------------------------------------------------
+def test_golden_dtype_clash_static_and_at_gate():
+    from paddle_tpu.framework import SHAPE_INFER_CONFLICT_ATTR
+    main = pt.Program()
+    blk = main.global_block()
+    x = blk.create_var("x", shape=[4], dtype="float32")
+    # a comparison produces bool; declaring its output numeric is the
+    # classic condition-wired-to-a-numeric-slot defect
+    out = blk.create_var("o", shape=[4], dtype="float32")
+    op = blk.append_op("less_than", {"X": x, "Y": x}, {"Out": out})
+    # the builder stamped the declared-vs-inferred conflict on the op
+    assert op.attrs.get(SHAPE_INFER_CONFLICT_ATTR), op.attrs
+
+    rep = analysis.verify_program(main, feed_names=["x"],
+                                  fetch_names=["o"])
+    hits = rep.by_code("dtype-mismatch")
+    assert hits, rep.render_text()
+    assert hits[0].severity == Severity.ERROR
+    assert hits[0].block_path == (0,) and hits[0].op_index == 0
+    assert "bool" in hits[0].message and "float32" in hits[0].message
+
+    # executor pre-compile gate: raises before tracing or compiling
+    exe = pt.Executor()
+    n_cached = len(exe._cache)
+    with pytest.raises(VerificationError, match="dtype-mismatch"):
+        exe.run(main, feed={"x": np.zeros((4,), np.float32)},
+                fetch_list=["o"])
+    assert len(exe._cache) == n_cached  # nothing was compiled
+
+
+def test_int_float_promotion_drift_is_warning_only():
+    """Python-scalar promotion (e.g. scale on an int tensor) floats
+    the traced value while the declared dtype stays int: reported, but
+    never an error — real programs in the suite do this (the runtime
+    follows the trace, not the declaration)."""
+    main = pt.Program()
+    blk = main.global_block()
+    x = blk.create_var("x", shape=[4], dtype="int64")
+    out = blk.create_var("o", shape=[4], dtype="int64")
+    blk.append_op("scale", {"X": x}, {"Out": out},
+                  attrs={"scale": 0.5})
+    rep = analysis.verify_program(main, feed_names=["x"],
+                                  fetch_names=["o"])
+    hits = rep.by_code("dtype-mismatch")
+    assert hits and hits[0].severity == Severity.WARNING
+    assert rep.ok
+
+
+# ---------------------------------------------------------------------------
+# golden defect 3: uninitialized persistable
+# ---------------------------------------------------------------------------
+def test_golden_uninitialized_persistable():
+    main, startup, loss = _mnist_mlp()
+    wname = main.all_parameters()[0].name
+    sblk = startup.desc.global_block
+    sblk.ops[:] = [op for op in sblk.ops
+                   if wname not in op.output_names()]
+
+    rep = analysis.verify_program(main, startup=startup,
+                                  feed_names=["img", "label"],
+                                  fetch_names=[loss.name])
+    hits = rep.by_code("uninit-persistable")
+    assert hits, rep.render_text()
+    d = hits[0]
+    assert d.severity == Severity.ERROR
+    assert d.var == wname and wname in d.message
+    assert d.block_path == (0,)
+    assert "startup" in d.hint
+    # the same pair through Trainer setup fails at start()
+    from paddle_tpu.trainer import Trainer
+    with pytest.raises(VerificationError, match="uninit-persistable"):
+        Trainer(loss, main_program=main, startup_program=startup).start()
+
+
+# ---------------------------------------------------------------------------
+# golden defect 4: dead op relative to the fetch targets
+# ---------------------------------------------------------------------------
+def test_golden_dead_op():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        h = layers.fc(x, size=4)
+        loss = layers.mean(h)
+        dead = layers.elementwise_add(x, x)  # feeds nothing
+
+    rep = analysis.verify_program(main, feed_names=["x"],
+                                  fetch_names=[loss.name])
+    hits = rep.by_code("dead-op")
+    assert hits, rep.render_text()
+    d = hits[0]
+    assert d.severity == Severity.WARNING   # dead code is not fatal
+    assert d.op_type == "elementwise_add"
+    assert d.block_path == (0,)
+    assert main.desc.global_block.ops[d.op_index].output_names() == \
+        [dead.name]
+    assert rep.ok  # warnings alone keep the program runnable
+
+
+# ---------------------------------------------------------------------------
+# golden defect 5: fetch of donated rw state — error at verify time
+# under (donate, async), warning otherwise; the executor path raises
+# BEFORE compiling, with the same guidance the runtime check gave
+# ---------------------------------------------------------------------------
+def test_golden_donated_fetch():
+    main, startup, loss = _mnist_mlp()
+    wname = main.all_parameters()[0].name
+
+    rep = analysis.verify_program(
+        main, feed_names=["img", "label"],
+        fetch_names=[loss.name, wname], donate=True,
+        async_dispatch=True)
+    hits = rep.by_code("donated-fetch")
+    assert hits and hits[0].severity == Severity.ERROR
+    assert hits[0].var == wname
+    assert "donated state" in hits[0].message
+    assert "sync=True" in hits[0].hint
+    assert "donate_state=False" in hits[0].hint
+
+    # same fetch under sync dispatch: downgraded to a warning
+    rep2 = analysis.verify_program(
+        main, feed_names=["img", "label"],
+        fetch_names=[loss.name, wname], donate=True,
+        async_dispatch=False)
+    hits2 = rep2.by_code("donated-fetch")
+    assert hits2 and hits2[0].severity == Severity.WARNING
+    assert rep2.ok
+
+    # trainer setup: train() always dispatches async, so a donated
+    # param in fetch_metrics fails at start(), before startup or
+    # checkpoint restore run
+    from paddle_tpu.trainer import Trainer
+    t = Trainer(loss, main_program=main, startup_program=startup,
+                fetch_metrics={"w": wname})
+    with pytest.raises(VerificationError, match="donated state"):
+        t.start()
+
+    # executor path: VerificationError (a ValueError, so pre-gate
+    # callers matching "donated state" still match) with NO compile
+    exe = pt.Executor()
+    assert exe.donate_state
+    exe.run(startup)
+    n_cached = len(exe._cache)
+    with pytest.raises(ValueError, match="donated state"):
+        exe.run(main, feed=_feed(), fetch_list=[loss.name, wname],
+                sync=False)
+    assert len(exe._cache) == n_cached
+
+
+# ---------------------------------------------------------------------------
+# no-false-positive sweep: healthy networks verify with zero errors
+# ---------------------------------------------------------------------------
+def test_healthy_networks_verify_clean():
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import lint_ir
+    for name in sorted(lint_ir.NETWORKS):
+        pt.reset_default_programs()
+        report = lint_ir.lint_network(name)
+        assert report.ok, \
+            f"network {name!r} not verifier-clean:\n{report.render_text()}"
+
+
+def test_healthy_train_and_serve_through_gates(tmp_path):
+    """The executor gate, trainer setup gate, save gate, and serving
+    load gate all pass on a healthy end-to-end train+freeze+load."""
+    from paddle_tpu.trainer import Trainer
+    main, startup, loss = _mnist_mlp()
+    trainer = Trainer(loss, main_program=main, startup_program=startup)
+
+    def reader():
+        for _ in range(2):
+            yield _feed()
+
+    trainer.train(num_passes=1, reader=reader)
+    pred_name = "fc_1.tmp_2"  # softmax output of the second fc
+    pred = main.global_block().var(pred_name)
+    pt.io.save_inference_model(str(tmp_path), ["img"], [pred],
+                               trainer.exe, main_program=main)
+    from paddle_tpu import serving
+    model = serving.load(str(tmp_path))
+    out = model.run_direct({"img": _feed()["img"]})
+    assert np.asarray(out[0]).shape == (4, 10)
+
+
+# ---------------------------------------------------------------------------
+# gate semantics
+# ---------------------------------------------------------------------------
+def test_verify_env_toggle_restores_runtime_behavior(monkeypatch):
+    """PADDLE_TPU_VERIFY=0 bypasses every gate: the donated-fetch case
+    falls through to the ORIGINAL runtime guard in core/executor.py."""
+    monkeypatch.setenv("PADDLE_TPU_VERIFY", "0")
+    assert not analysis.verify_enabled()
+    main, startup, loss = _mnist_mlp()
+    wname = main.all_parameters()[0].name
+    exe = pt.Executor()
+    exe.run(startup)
+    with pytest.raises(ValueError, match="donated state") as ei:
+        exe.run(main, feed=_feed(), fetch_list=[loss.name, wname],
+                sync=False)
+    assert not isinstance(ei.value, VerificationError)  # runtime path
+
+
+def test_gate_memoized_per_program_version():
+    from paddle_tpu.analysis import verifier as v
+    main, startup, loss = _mnist_mlp()
+    exe = pt.Executor()
+    exe.run(startup)
+    before = dict(v._gate_cache)
+    exe.run(main, feed=_feed(), fetch_list=[loss.name])
+    added = set(v._gate_cache) - set(before)
+    assert len(added) == 1
+    exe.run(main, feed=_feed(), fetch_list=[loss.name])
+    assert set(v._gate_cache) - set(before) == added  # cache hit
+
+
+def test_verify_time_published_to_registry():
+    from paddle_tpu.observability import default_registry
+    reg = default_registry()
+    main, startup, loss = _mnist_mlp()
+    fam = reg.get("paddle_tpu_verify_seconds")
+    count0 = fam.snapshot()["count"] if fam is not None else 0
+    analysis.verify_program(main, startup=startup,
+                            feed_names=["img", "label"],
+                            fetch_names=[loss.name])
+    fam = reg.get("paddle_tpu_verify_seconds")
+    assert fam is not None and fam.snapshot()["count"] == count0 + 1
+    total = reg.get("paddle_tpu_verify_total")
+    assert total is not None
+
+
+# ---------------------------------------------------------------------------
+# report plumbing
+# ---------------------------------------------------------------------------
+def test_report_json_and_text_render():
+    main = pt.Program()
+    blk = main.global_block()
+    x = blk.create_var("x", shape=[2], dtype="float32")
+    blk.append_op("elementwise_add", {"X": x, "Y": "ghost"},
+                  {"Out": "o"})
+    blk.create_var("o", shape=[2], dtype="float32")
+    rep = analysis.verify_program(main, feed_names=["x"],
+                                  fetch_names=["o"])
+    payload = json.loads(rep.to_json())
+    assert payload["ok"] is False
+    assert payload["counts"]["error"] >= 1
+    codes = {d["code"] for d in payload["diagnostics"]}
+    assert "dangling-input" in codes
+    text = rep.render_text()
+    assert "error[dangling-input]" in text and "ghost" in text
+    with pytest.raises(VerificationError, match="dangling-input"):
+        rep.raise_if_errors()
+
+
+def test_shape_coverage_reported_not_silently_passed():
+    """An op whose inputs have no declared shapes can't be abstractly
+    evaluated: the verifier says so instead of passing it through."""
+    from paddle_tpu.framework import SHAPE_INFER_SKIPPED_ATTR
+    main = pt.Program()
+    blk = main.global_block()
+    x = blk.create_var("x", dtype="float32")        # no shape
+    op = blk.append_op("elementwise_add", {"X": x, "Y": x},
+                       {"Out": "o"})
+    blk.create_var("o", dtype="float32")
+    assert op.attrs.get(SHAPE_INFER_SKIPPED_ATTR)   # builder recorded it
+    rep = analysis.verify_program(main, feed_names=["x"],
+                                  fetch_names=["o"])
+    cov = rep.by_code("shape-coverage")
+    assert cov and cov[0].severity == Severity.WARNING
+    assert cov[0].op_index == 0
+
+
+def test_control_flow_ops_have_explicit_infer_rules():
+    """The backfilled rules cover the former top coverage gaps: the
+    control-flow family builds WITHOUT skip markers, and if_else /
+    static_rnn outputs get shapes the generic trace could not fill."""
+    from paddle_tpu.framework import SHAPE_INFER_SKIPPED_ATTR
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [5, 8], append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            mem = rnn.memory(shape=[8], value=0.0)
+            nh = layers.elementwise_add(xt, mem)
+            rnn.update_memory(mem, nh)
+            rnn.step_output(nh)
+        rnn_out = rnn()
+
+        cond = layers.less_than(layers.mean(x),
+                                layers.fill_constant([1], "float32", 0.5))
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            ie.output(layers.elementwise_add(x, x))
+        with ie.false_block():
+            ie.output(layers.elementwise_sub(x, x))
+        ie_out = ie()
+    for blk in main.desc.blocks:
+        for op in blk.ops:
+            if op.type in ("static_rnn", "if_else", "while",
+                           "dynamic_rnn"):
+                assert SHAPE_INFER_SKIPPED_ATTR not in op.attrs, \
+                    (op.type, op.attrs)
+    assert rnn_out.shape == (5, 8)       # [T, *step_shape]
+    assert ie_out.shape == (5, 8)        # mirrors the true branch
+
+
+# ---------------------------------------------------------------------------
+# diagnostic-colored DOT export
+# ---------------------------------------------------------------------------
+def test_draw_graph_colors_diagnostics(tmp_path):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        h = layers.fc(x, size=4)
+        loss = layers.mean(h)
+        layers.elementwise_add(x, x)     # dead -> warning (yellow)
+    blk = main.desc.global_block
+    blk.append_op("elementwise_add", {"X": ["@ghost@"],
+                                      "Y": ["@ghost@"]},
+                  {"Out": [loss.name]})  # dangling -> error (red)
+    rep = analysis.verify_program(main, feed_names=["x"],
+                                  fetch_names=[loss.name])
+    dot = pt.debug.draw_graph(main, path=str(tmp_path / "g.dot"),
+                              diagnostics=rep)
+    assert (tmp_path / "g.dot").read_text() == dot
+    bad_i = len(blk.ops) - 1
+    bad_line = next(l for l in dot.splitlines()
+                    if l.strip().startswith(f'"op_{bad_i}" '))
+    assert "dangling-input" in bad_line
+    assert 'fillcolor="tomato"' in bad_line   # error op is red
+    assert 'fillcolor="tomato"' in dot
+    assert 'fillcolor="gold"' in dot      # dead op is yellow
+    # healthy ops keep the neutral fill
+    assert 'fillcolor="lightgray"' in dot
+    # without diagnostics the export is unchanged (no colors)
+    plain = pt.debug.draw_graph(main)
+    assert "tomato" not in plain and "gold" not in plain

@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""lint_ir: run the static ProgramDesc verifier from the command line.
+
+Two input modes:
+
+  python tools/lint_ir.py <saved_inference_model_dir>
+      Load a `save_inference_model` directory (program + params) into a
+      private scope and verify the frozen program.
+
+  python tools/lint_ir.py --network mnist_mlp
+      Build one of the named test networks (the same graph shapes the
+      test suite exercises) and verify its (main, startup) pair —
+      including uninitialized-persistable detection, which needs both.
+
+Exit status: 0 when the verifier finds no error-severity diagnostics,
+1 when it does (warnings never fail the lint; --strict promotes them).
+tests/test_lint_cli.py drives every named network through this tool so
+CI keeps the suite's programs verifier-clean.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _build_fc_regression():
+    import paddle_tpu as pt
+    from paddle_tpu import layers, optimizer
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [13])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square(pred - y))
+        optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+    return main, startup, ["x", "y"], [loss.name]
+
+
+def _build_mnist(net: str):
+    import paddle_tpu as pt
+    from paddle_tpu import layers, optimizer
+    from paddle_tpu.models import mnist
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        shape = [784] if net == "mlp" else [1, 28, 28]
+        img = layers.data("img", shape)
+        label = layers.data("label", [1], dtype="int64")
+        fn = mnist.mlp if net == "mlp" else mnist.conv_net
+        _pred, loss, acc = fn(img, label)
+        optimizer.AdamOptimizer(learning_rate=0.001).minimize(loss)
+    return main, startup, ["img", "label"], [loss.name, acc.name]
+
+
+def _build_seq_pool():
+    import paddle_tpu as pt
+    from paddle_tpu import layers, optimizer
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        seq = layers.data("seq", [16], lod_level=1)
+        y = layers.data("y", [1])
+        h = layers.fc(seq, size=16, act="tanh")
+        pooled = layers.sequence_pool(h, "sum")
+        loss = layers.mean(layers.square(layers.fc(pooled, size=1) - y))
+        optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+    return main, startup, ["seq", "y"], [loss.name]
+
+
+def _build_embedding_lm():
+    import paddle_tpu as pt
+    from paddle_tpu import layers, optimizer
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        words = layers.data("words", [1], dtype="int64", lod_level=1)
+        label = layers.data("label", [1], dtype="int64")
+        emb = layers.embedding(words, size=[100, 16])
+        pooled = layers.sequence_pool(emb, "sum")
+        pred = layers.fc(pooled, size=100, act="softmax")
+        loss = layers.mean(layers.cross_entropy(input=pred, label=label))
+        optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+    return main, startup, ["words", "label"], [loss.name]
+
+
+def _build_while_loop():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        i = layers.fill_constant([1], "int32", 0)
+        n = layers.fill_constant([1], "int32", 3)
+        s = layers.fc(x, size=4)
+        w = layers.While(layers.less_than(i, n), max_steps=8)
+        with w.block():
+            layers.assign(layers.elementwise_add(s, s), s)
+            layers.assign(layers.increment(i, in_place=False), i)
+        out = layers.mean(s)
+    return main, startup, ["x"], [out.name]
+
+
+def _build_static_rnn():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [5, 8], append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            mem = rnn.memory(shape=[8], value=0.0)
+            nh = layers.fc(layers.elementwise_add(xt, mem), size=8,
+                           act="tanh")
+            rnn.update_memory(mem, nh)
+            rnn.step_output(nh)
+        out = layers.mean(rnn())
+    return main, startup, ["x"], [out.name]
+
+
+def _build_dynamic_rnn():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        sent = layers.data("sent", [8], lod_level=1)
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            wd = drnn.step_input(sent)
+            mem = drnn.memory(shape=[8], value=0.0)
+            nh = layers.fc(layers.elementwise_add(wd, mem), size=8,
+                           act="tanh")
+            drnn.update_memory(mem, nh)
+            drnn.output(nh)
+        last = layers.sequence_last_step(drnn())
+        out = layers.mean(layers.fc(last, size=1))
+    return main, startup, ["sent"], [out.name]
+
+
+def _build_ifelse():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        cond = layers.less_than(
+            layers.mean(x), layers.fill_constant([1], "float32", 0.5))
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            ie.output(layers.elementwise_add(x, x))
+        with ie.false_block():
+            ie.output(layers.elementwise_sub(x, x))
+        out = layers.mean(ie())
+    return main, startup, ["x"], [out.name]
+
+
+#: name -> builder returning (main, startup, feed_names, fetch_names).
+#: These mirror the network shapes the test suite runs (fc regression,
+#: the mnist book nets, sequence/lod pipelines, and every control-flow
+#: construct) — tests/test_lint_cli.py keeps each verifier-clean.
+NETWORKS = {
+    "fc_regression": _build_fc_regression,
+    "mnist_mlp": lambda: _build_mnist("mlp"),
+    "mnist_conv": lambda: _build_mnist("conv"),
+    "seq_pool": _build_seq_pool,
+    "embedding_lm": _build_embedding_lm,
+    "while_loop": _build_while_loop,
+    "static_rnn": _build_static_rnn,
+    "dynamic_rnn": _build_dynamic_rnn,
+    "ifelse": _build_ifelse,
+}
+
+
+def lint_network(name: str, retrace: bool = True):
+    """Build the named network and verify it. Returns a VerifyReport."""
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis.passes import fast_passes
+    main, startup, feeds, fetches = NETWORKS[name]()
+    passes = None if retrace else fast_passes(with_uninit=True)
+    return analysis.verify_program(
+        main, startup=startup, feed_names=feeds, fetch_names=fetches,
+        passes=passes, program_label=f"network {name!r}")
+
+
+def lint_model_dir(dirname: str):
+    """Load a save_inference_model directory and verify the frozen
+    program (private scope: the process global scope is untouched)."""
+    import paddle_tpu as pt
+    from paddle_tpu import analysis, io
+
+    scope = pt.Scope()
+    exe = pt.Executor()
+    with pt.scope_guard(scope):
+        prog, feed_names, fetch_vars, _meta = io.load_inference_model(
+            dirname, exe, return_meta=True)
+    return analysis.verify_program(
+        prog, feed_names=feed_names,
+        fetch_names=[v.name for v in fetch_vars],
+        program_label=f"model dir {dirname!r}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="lint_ir",
+        description="Static ProgramDesc verifier (paddle_tpu.analysis) "
+                    "over a saved inference model or a named test "
+                    "network.")
+    ap.add_argument("model_dir", nargs="?",
+                    help="save_inference_model directory to verify")
+    ap.add_argument("--network", choices=sorted(NETWORKS),
+                    help="build + verify a named test network instead")
+    ap.add_argument("--list-networks", action="store_true",
+                    help="print the known network names and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of text")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress warning/info output (errors always "
+                         "print)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on warnings too")
+    ap.add_argument("--no-retrace", action="store_true",
+                    help="network mode: skip the abstract-inference "
+                         "re-trace, rely on build-time markers (the "
+                         "executor gate's fast mode)")
+    args = ap.parse_args(argv)
+
+    if args.list_networks:
+        for n in sorted(NETWORKS):
+            print(n)
+        return 0
+    if bool(args.model_dir) == bool(args.network):
+        ap.error("give exactly one of: a model dir, or --network NAME")
+
+    if args.network:
+        report = lint_network(args.network, retrace=not args.no_retrace)
+    else:
+        report = lint_model_dir(args.model_dir)
+
+    if args.json:
+        print(report.to_json())
+    else:
+        from paddle_tpu.analysis import Severity
+        min_sev = Severity.ERROR if args.quiet else Severity.INFO
+        print(report.render_text(min_severity=min_sev))
+    if not report.ok:
+        return 1
+    if args.strict and report.warnings:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
