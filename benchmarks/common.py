@@ -56,7 +56,7 @@ def run_benchmark(exe, program, feed, loss_var, args, unit_per_step,
 
 
 def time_chain(fn, x0, flops_per_call, label, n1=10, n2=110,
-               repeats=3, peak_flops=197e12):
+               repeats=3, peak_flops=None):
     """Kernel-A/B marginal timing: jit with donated self-chained arg
     (the tunnel only fast-paths executes whose argument buffers it has
     seen), 3 warmups + a synced throwaway, then median of `repeats`
@@ -66,6 +66,11 @@ def time_chain(fn, x0, flops_per_call, label, n1=10, n2=110,
 
     import jax
     import jax.numpy as jnp
+
+    if peak_flops is None:  # canonical v5e bf16 peak
+        from paddle_tpu.observability.attribution import \
+            PEAK_FLOPS_DEFAULT
+        peak_flops = PEAK_FLOPS_DEFAULT
 
     jitted = jax.jit(fn, donate_argnums=(0,))
     x = jnp.copy(x0)

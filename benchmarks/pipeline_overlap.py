@@ -38,7 +38,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 BLOCKED_EVENTS = ("pipeline::prefetch_wait", "pipeline::fetch_sync",
-                  "pipeline::host_blocked")
+                  "pipeline::host_blocked", "pipeline::sync_barrier")
 
 
 def build_mlp(in_dim, hidden, classes):

@@ -27,7 +27,6 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 BATCH = int(os.environ.get("BENCH_BATCH", "128"))
-V5E_PEAK_FLOPS = 197e12
 V5E_HBM_BYTES_PER_S = 819e9  # v5e HBM bandwidth ~819 GB/s
 
 
@@ -173,6 +172,11 @@ def ablations(pt, feed, quick=False):
 def main():
     quick = "--quick" in sys.argv
     import paddle_tpu as pt
+    # the canonical v5e bf16 peak — same constant the live
+    # paddle_tpu_mfu gauge divides by, so mfu_est and the gauge agree
+    # by construction (imported here: module import stays jax-free)
+    from paddle_tpu.observability.attribution import \
+        PEAK_FLOPS_DEFAULT as V5E_PEAK_FLOPS
     amp_on = os.environ.get("PADDLE_TPU_AMP", "1") == "1"
     pt.amp.enable(amp_on)
     rng = np.random.RandomState(0)
@@ -182,6 +186,27 @@ def main():
 
     ca, exe, main_p, f = cost_analysis(pt, feed)
     out["cost_analysis"] = ca
+    # cross-check: the static cost model (the numerator of the live
+    # paddle_tpu_mfu gauge) against XLA's own count for the SAME
+    # program — the acceptance band for the always-on attribution is
+    # static/xla within 20% on conv/matmul-dominated nets
+    try:
+        from paddle_tpu.analysis import cost_model
+        static = cost_model.program_cost(
+            main_p, feed_shapes={k: v.shape for k, v in feed.items()})
+        out["cost_model"] = {
+            "flops": static.flops,
+            "bytes_accessed": static.bytes_accessed,
+            "param_bytes": static.param_bytes,
+            "exact_flops_fraction":
+                round(static.exact_flops_fraction, 3),
+        }
+        xla_flops = float(ca.get("flops", 0) or 0)
+        if xla_flops:
+            out["cost_model"]["flops_vs_xla"] = round(
+                static.flops / xla_flops, 3)
+    except Exception as e:
+        out["cost_model"] = {"error": repr(e)[:300]}
     flops = float(ca.get("flops", 0) or 0)
     byts = float(ca.get("bytes accessed", 0) or 0)
     if flops and byts:

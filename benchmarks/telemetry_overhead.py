@@ -1,22 +1,30 @@
-"""A/B: registry instrumentation ON vs OFF on the mnist-sized trainer
-loop — the proof that always-on telemetry is affordable.
+"""A/B/C/D: telemetry + attribution ON vs OFF on the mnist-sized trainer
+loop — the proof that always-on telemetry AND attribution are
+affordable.
 
-Both arms run the identical Trainer event loop over the identical
-deterministic reader; the only difference is the process default
-MetricsRegistry:
+All arms run the identical Trainer event loop over the identical
+deterministic reader; the only differences are the process default
+MetricsRegistry and the attribution/flight-recorder toggles:
 
-  off   MetricsRegistry(enabled=False) — the Trainer's telemetry kill
-        switch: registry instruments are shared no-ops and the
-        per-dispatch StepTrace span + clock reads are skipped entirely
-        (the pre-observability loop).
-  on    a live MetricsRegistry — steps_total / step_seconds /
-        compile-cache counters / prefetch gauge record and every
-        dispatch runs under a StepTrace root span, exactly as a
-        production scrape sees it.
+  off        MetricsRegistry(enabled=False) — the Trainer's telemetry
+             kill switch: registry instruments are shared no-ops and
+             the per-dispatch StepTrace span + clock reads are skipped
+             entirely (the pre-observability loop). Attribution and
+             the flight recorder are off too.
+  on_noattr  a live MetricsRegistry, attribution OFF and flight
+             recorder OFF — the PR-4 instrumentation level (metrics +
+             spans, no MFU/phase publication, no event ring buffer).
+  on_noflight  registry + attribution ON, flight recorder OFF —
+             isolates the MFU/phase cost from the ring buffer's.
+  on         everything: registry + StepTrace spans + MFU/model-FLOPs
+             gauges + per-phase step breakdown + flight-recorder ring
+             buffer, exactly what a production scrape sees.
 
 Prints ONE JSON report (same shape conventions as
-benchmarks/pipeline_overlap.py): steps/sec per arm and the overhead
-percentage, which the PR contract requires to stay under 2%.
+benchmarks/pipeline_overlap.py): steps/sec per arm, the full-on
+overhead percentage (contract: < 2%), and the marginal attribution
+(on_noflight vs on_noattr) and flight-recorder (on vs on_noflight)
+costs, each isolated by its own arm pair.
 
     python benchmarks/telemetry_overhead.py --batches 60 --passes 3
 """
@@ -64,14 +72,22 @@ def reader(n_batches, bs, in_dim, classes, seed=7):
     return read
 
 
-def timed_round(trainer, enabled: bool, args) -> float:
-    """One timed train() segment under the given registry arm. The
-    trainer (and its compiled executable) is shared across arms — the
-    registry swap is the ONLY difference, so the A/B isolates
-    instrumentation cost from compile/GC churn."""
+def timed_round(trainer, args, registry_on: bool, attribution_on: bool,
+                flight_on: bool) -> float:
+    """One timed train() segment under the given arm. The trainer (and
+    its compiled executable) is shared across arms — the toggles are
+    the ONLY difference, so the A/B isolates instrumentation cost from
+    compile/GC churn."""
     from paddle_tpu import observability as obs
+    from paddle_tpu.observability import attribution
+    from paddle_tpu.observability.flight_recorder import flight_recorder
 
-    prev = obs.set_default_registry(obs.MetricsRegistry(enabled=enabled))
+    prev = obs.set_default_registry(
+        obs.MetricsRegistry(enabled=registry_on))
+    prev_attr = attribution.set_attribution_enabled(attribution_on)
+    rec = flight_recorder()
+    was_enabled = rec.enabled
+    (rec.enable if flight_on else rec.disable)()
     try:
         t0 = time.monotonic()
         trainer.train(num_passes=args.passes,
@@ -81,6 +97,8 @@ def timed_round(trainer, enabled: bool, args) -> float:
         return time.monotonic() - t0
     finally:
         obs.set_default_registry(prev)
+        attribution.set_attribution_enabled(prev_attr)
+        (rec.enable if was_enabled else rec.disable)()
 
 
 def main():
@@ -89,10 +107,12 @@ def main():
                    help="batches per pass")
     p.add_argument("--passes", type=int, default=3,
                    help="timed passes per arm per round")
-    p.add_argument("--repeats", type=int, default=7,
-                   help="interleaved off/on rounds (first arm "
-                        "alternates); medians are compared, which "
-                        "cancels scheduler noise and position effects")
+    p.add_argument("--repeats", type=int, default=8,
+                   help="interleaved off/on rounds; keep this a "
+                        "multiple of the 4 arms so the first-arm "
+                        "rotation puts every arm in every position "
+                        "equally often (medians then cancel scheduler "
+                        "noise and position effects)")
     p.add_argument("--batch_size", type=int, default=64)
     p.add_argument("--in_dim", type=int, default=784)
     p.add_argument("--hidden", type=int, default=256)
@@ -113,15 +133,20 @@ def main():
         2, args.batch_size, args.in_dim, args.classes))
 
     steps = args.passes * args.batches
-    walls = {"off": [], "on": []}
+    #: arm -> (registry_on, attribution_on, flight_on)
+    arms = {"off": (False, False, False),
+            "on_noattr": (True, False, False),
+            "on_noflight": (True, True, False),
+            "on": (True, True, True)}
+    walls = {name: [] for name in arms}
+    names = list(arms)
     for rnd in range(args.repeats):
-        # alternate which arm goes FIRST each round: position effects
+        # rotate which arm goes FIRST each round: position effects
         # (GC debt from the previous segment, cache warmth) would
         # otherwise bias one arm systematically
-        order = (("off", False), ("on", True)) if rnd % 2 == 0 \
-            else (("on", True), ("off", False))
-        for name, enabled in order:
-            walls[name].append(timed_round(trainer, enabled, args))
+        order = names[rnd % len(names):] + names[:rnd % len(names)]
+        for name in order:
+            walls[name].append(timed_round(trainer, args, *arms[name]))
 
     def stats(ws):
         ws = sorted(ws)
@@ -134,10 +159,18 @@ def main():
             "steps_per_sec_best": round(steps / ws[0], 2),
         }
 
-    off, on = stats(walls["off"]), stats(walls["on"])
+    off, on_noattr, on_noflight, on = (
+        stats(walls["off"]), stats(walls["on_noattr"]),
+        stats(walls["on_noflight"]), stats(walls["on"]))
     overhead_pct = round(
         (off["steps_per_sec"] - on["steps_per_sec"])
         / off["steps_per_sec"] * 100.0, 3)
+    attribution_overhead_pct = round(
+        (on_noattr["steps_per_sec"] - on_noflight["steps_per_sec"])
+        / on_noattr["steps_per_sec"] * 100.0, 3)
+    flight_overhead_pct = round(
+        (on_noflight["steps_per_sec"] - on["steps_per_sec"])
+        / on_noflight["steps_per_sec"] * 100.0, 3)
     report = {
         "benchmark": "telemetry_overhead",
         "batches": args.batches,
@@ -147,8 +180,12 @@ def main():
         "in_dim": args.in_dim,
         "hidden": args.hidden,
         "off": off,
+        "on_noattr": on_noattr,
+        "on_noflight": on_noflight,
         "on": on,
         "overhead_pct": overhead_pct,
+        "attribution_overhead_pct": attribution_overhead_pct,
+        "flight_overhead_pct": flight_overhead_pct,
         "budget_pct": 2.0,
         "within_budget": overhead_pct < 2.0,
     }

@@ -16,10 +16,13 @@ from .passes import (AnalysisPass, PASS_REGISTRY, PassContext,  # noqa
                      default_passes, register_pass)
 from .verifier import (ProgramVerifier, clear_gate_cache,  # noqa
                        executor_gate, verify_enabled, verify_program)
+from .cost_model import (CostModelPass, OpCost, ProgramCost,  # noqa
+                         program_cost)
 
 __all__ = [
     "Diagnostic", "Severity", "VerificationError", "VerifyReport",
     "AnalysisPass", "PASS_REGISTRY", "PassContext", "default_passes",
     "register_pass", "ProgramVerifier", "verify_program",
     "verify_enabled", "executor_gate", "clear_gate_cache",
+    "CostModelPass", "OpCost", "ProgramCost", "program_cost",
 ]

@@ -89,6 +89,8 @@ class VerifyReport:
                  program_label: str = "program"):
         self.diagnostics: List[Diagnostic] = list(diagnostics or [])
         self.program_label = program_label
+        # filled by the cost_model pass when it runs in the pipeline
+        self.cost = None
 
     def add(self, diag: Diagnostic) -> Diagnostic:
         self.diagnostics.append(diag)
@@ -141,7 +143,18 @@ class VerifyReport:
 
     def raise_if_errors(self, context: str = ""):
         if not self.ok:
-            raise VerificationError(self, context=context)
+            err = VerificationError(self, context=context)
+            try:
+                # a failed verification is a flight-recorder trigger:
+                # the dump carries the recent events + metrics leading
+                # up to the rejected program (no-op when disabled)
+                from ..observability.flight_recorder import record_failure
+                record_failure("verification_error", exc=err,
+                               context={"program": self.program_label,
+                                        "context": context})
+            except Exception:
+                pass  # telemetry must never mask the verification error
+            raise err
         return self
 
     def __len__(self):

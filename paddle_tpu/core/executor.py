@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from ..amp import amp_enabled
 from .. import profiler
+from ..observability import trace as obs_trace
 from ..observability.registry import default_registry
 from .ir import Program, BlockDesc, OpDesc, SUB_BLOCK_ATTRS
 from .lod import LoDTensor, RaggedNested, RaggedPair, RaggedTree
@@ -221,12 +222,19 @@ class StepResult:
     dispatch."""
 
     def __init__(self, raw_fetches, fetch_names, return_numpy: bool,
-                 nan_check: bool = False):
+                 nan_check: bool = False, trace_ctx=None):
         self._raw = list(raw_fetches)
         self.fetch_names = list(fetch_names)
         self._return_numpy = return_numpy
         self._nan_check = nan_check
+        # the step span active at dispatch: lazy materialization stamps
+        # its fetch_sync event with the OWNING step's ids even when it
+        # runs under a later step's span (or none) — see trace.use_span
+        self._trace_ctx = trace_ctx
         self._values: Optional[List[Any]] = None
+        #: static ProgramCost of the executable this dispatch ran
+        #: (set by Executor.run; None when the cost pass failed)
+        self.cost = None
 
     @property
     def ready(self) -> bool:
@@ -249,17 +257,25 @@ class StepResult:
     def fetches(self) -> List[Any]:
         """Materialized fetch values (cached after the first call)."""
         if self._values is None:
-            with profiler.RecordEvent("pipeline::fetch_sync",
-                                      cat=profiler.CAT_PIPELINE):
-                vals = [_to_host_value(v, self._return_numpy)
-                        for v in self._raw]
+            with obs_trace.use_span(self._trace_ctx):
+                with profiler.RecordEvent("pipeline::fetch_sync",
+                                          cat=profiler.CAT_PIPELINE):
+                    vals = [_to_host_value(v, self._return_numpy)
+                            for v in self._raw]
             if self._nan_check:
                 for n, v in zip(self.fetch_names, vals):
                     arr = v.data if isinstance(v, LoDTensor) else v
                     if np.issubdtype(np.asarray(arr).dtype, np.floating) \
                             and not np.isfinite(arr).all():
-                        raise FloatingPointError(
+                        err = FloatingPointError(
                             f"NaN/Inf detected in fetched var {n!r}")
+                        # flight-recorder trigger: the dump holds the
+                        # events leading up to the poisoned step
+                        from ..observability.flight_recorder import \
+                            record_failure
+                        record_failure("nan_fetch", exc=err,
+                                       context={"var": n})
+                        raise err
             self._values = vals
             self._raw = []  # release device references
         return list(self._values)
@@ -350,6 +366,10 @@ class CompiledProgram:
         self.jitted = jitted
         self.ro_names = list(ro_names)
         self.rw_names = list(rw_names)
+        # static ProgramCost of ONE traced iteration, attached by
+        # Executor.run at the compile-cache miss that built this
+        # executable (None when the cost model could not run)
+        self.cost = None
 
 
 class _BlockPrefix:
@@ -521,6 +541,9 @@ class Executor:
         # an already-jitted executable; a miss means it traced+compiled.
         # Serving reads these for its compile_cache_hit_rate metric.
         self.cache_stats: Dict[str, int] = {"hits": 0, "misses": 0}
+        # static ProgramCost of the most recently dispatched executable
+        # — the numerator of the live MFU gauge (trainer, serving)
+        self.last_cost = None
         _LIVE_EXECUTORS.add(self)
 
     # ------------------------------------------------------------------
@@ -855,10 +878,29 @@ class Executor:
             compiled = self._compile(program, block, feed_sig, fetch_names,
                                      scope, while_bounds=while_bounds,
                                      donate=self.donate_state, **kw)
+            # static cost attribution, attached once per compiled
+            # executable: per-op FLOPs/bytes with the dynamic batch dim
+            # bound from THIS dispatch's feed shapes (stacked feeds
+            # strip the leading K axis — the cost is per traced
+            # iteration, matching the per-batch step_seconds the
+            # trainer divides by). Best-effort: the cost model must
+            # never fail a compile.
+            try:
+                from ..analysis import cost_model as _cost_model
+                fs = {}
+                for fk, fv in feed_vals.items():
+                    shp = getattr(fv, "shape", None)
+                    if isinstance(shp, tuple):
+                        fs[fk] = shp[1:] if stacked_feed else shp
+                compiled.cost = _cost_model.program_cost(
+                    program, block_idx, feed_shapes=fs)
+            except Exception:
+                compiled.cost = None
             self._cache[key] = compiled
         else:
             self.cache_stats["hits"] += 1
             obs_hits.inc()
+        self.last_cost = compiled.cost
 
         if not sync and self.donate_state:
             rw = set(compiled.rw_names)
@@ -916,8 +958,38 @@ class Executor:
             self._deferred_flags = still
         result = StepResult(fetches[:n_user_fetches],
                             fetch_names[:n_user_fetches], return_numpy,
-                            nan_check=CHECK_NAN_INF)
+                            nan_check=CHECK_NAN_INF,
+                            trace_ctx=obs_trace.current())
+        # THIS dispatch's static cost rides on the result: consumers on
+        # other threads (serving workers sharing one executor) must not
+        # read the executor-global last_cost, which the next dispatch
+        # overwrites
+        result.cost = compiled.cost
         return result.fetches() if sync else result
+
+    def cost_for(self, program):
+        """The static ProgramCost attached to a compiled executable of
+        ``program`` (any feed signature), or None if none was compiled
+        by this executor yet."""
+        desc = program.desc if hasattr(program, "desc") else program
+        # snapshot: a concurrent run() populating the cache on a miss
+        # must not blow up this introspection with a resize error
+        for k, compiled in list(self._cache.items()):
+            # (uid, version) — a superseded build of the same program
+            # may still sit in the cache; its cost describes a graph
+            # that no longer exists
+            if k[0] == desc.uid and k[1] == desc.version \
+                    and compiled.cost is not None:
+                return compiled.cost
+        return None
+
+    def cost_table(self, program=None, limit: int = 20) -> Optional[str]:
+        """Rendered per-op cost table for ``program`` (default: the
+        most recently dispatched executable) — the Executor-level view
+        of the always-on attribution."""
+        cost = self.cost_for(program) if program is not None \
+            else self.last_cost
+        return None if cost is None else cost.table(limit=limit)
 
     def synchronize(self):
         """Barrier: block until every state write dispatched by this
@@ -926,7 +998,10 @@ class Executor:
         snapshot can never race the in-flight step (and an async XLA
         error surfaces here, at a named point, instead of inside the
         tmp-write)."""
-        with profiler.RecordEvent("pipeline::host_blocked",
+        # distinct from pipeline::host_blocked (feed-phase time): this
+        # wait is DEVICE time, and the attribution breakdown charges
+        # unmapped events to the device residual
+        with profiler.RecordEvent("pipeline::sync_barrier",
                                   cat=profiler.CAT_PIPELINE):
             for leaf in jax.tree_util.tree_leaves(self._inflight_state):
                 if hasattr(leaf, "block_until_ready"):

@@ -95,6 +95,32 @@ FLAGS: Dict[str, tuple] = {
         "to the Pallas flash kernel; below it the naive composition "
         "wins on v5e (measured crossover ~512 — MFU_BREAKDOWN.md "
         "round 3)"),
+    "PADDLE_TPU_ATTRIBUTION": (
+        "1", "observability/attribution.py (published from trainer.py, "
+        "serving/engine.py)",
+        "live performance attribution: paddle_tpu_mfu / "
+        "paddle_tpu_model_flops gauges and the per-phase step-time "
+        "breakdown; 0 disables publication (the disabled metrics "
+        "registry also turns it off; set_attribution_enabled() "
+        "overrides the env)"),
+    "PADDLE_TPU_PEAK_FLOPS": (
+        "197e12", "observability/attribution.py",
+        "device peak FLOP/s the MFU gauge is normalized against "
+        "(default: v5e bf16 peak, same constant as "
+        "benchmarks/profile_mfu.py); read per step so tests can "
+        "flip it"),
+    "PADDLE_TPU_FLIGHT_RECORDER": (
+        "1", "observability/flight_recorder.py",
+        "failure flight recorder: bounded ring of recent profiler "
+        "events dumped as a chrome-trace + JSON bundle when a failure "
+        "trigger fires (NaN at fetch, circuit-breaker open, checkpoint "
+        "failure, VerificationError); 0 removes the listener entirely "
+        "(zero overhead, nothing ever written)"),
+    "PADDLE_TPU_FLIGHT_DIR": (
+        "<tmpdir>/paddle_tpu_flightrec", "observability/flight_recorder.py",
+        "directory flight-recorder dump bundles are written to "
+        "(flightrec_<ms>_<pid>_<seq>_<reason>/, pruned to this "
+        "process's newest 8)"),
     "PADDLE_TPU_BN_CUSTOM_VJP": (
         "0", "ops/nn_ops.py",
         "use the round-2 hand-written BatchNorm backward (custom_vjp) "

@@ -1,7 +1,8 @@
 """paddle_tpu.observability — unified metrics registry, step tracing,
-and a scrapeable telemetry endpoint.
+a scrapeable telemetry endpoint, live performance attribution, and a
+failure flight recorder.
 
-Three pieces (see each module's docstring for the design argument):
+Five pieces (see each module's docstring for the design argument):
 
 - ``registry``: process-wide MetricsRegistry — labeled counters,
   gauges, and windowed histograms (nearest-rank p50/p90/p99) behind
@@ -19,6 +20,13 @@ Three pieces (see each module's docstring for the design argument):
 - ``server``: TelemetryServer — stdlib HTTP serving ``/metrics``
   (Prometheus text exposition), ``/healthz`` (from
   resilience.health), and ``/statusz`` (JSON snapshot).
+- ``attribution``: live MFU (static cost-model FLOPs / wall / peak)
+  and the per-step phase breakdown
+  (``paddle_tpu_step_phase_seconds{phase=...}``) answering
+  "compute-bound or input-bound, and at what MFU" off one scrape.
+- ``flight_recorder``: bounded ring buffer of recent profiler events,
+  auto-dumping a chrome-trace + metrics bundle on failure triggers
+  (NaN fetch, checkpoint failure, breaker open, VerificationError).
 
 Quickstart::
 
@@ -29,17 +37,33 @@ Quickstart::
     srv.start()
     # curl :9187/metrics   -> one scrape: training + serving + resilience
 """
-from . import trace  # noqa: F401
+from . import attribution, trace  # noqa: F401
+# NOTE: the module's flight_recorder() singleton accessor is NOT
+# re-exported here — the name would shadow the submodule attribute;
+# reach it via observability.flight_recorder.flight_recorder()
+from .flight_recorder import (FlightRecorder,  # noqa: F401
+                              record_failure, set_flight_recorder)
+from . import flight_recorder  # noqa: F401
+
+# The default recorder must be LIVE before the first failure fires — a
+# lazily-built one would capture nothing and dump an EMPTY ring for the
+# first (often only) failure of the process. Built disabled (no
+# listener, zero overhead) when PADDLE_TPU_FLIGHT_RECORDER=0; the env
+# is read at import like the other process-level toggles.
+flight_recorder.flight_recorder()
 from .registry import (METRIC_NAME_RE, Counter, Gauge,  # noqa: F401
                        Histogram, MetricsRegistry, add_global_collector,
                        default_registry, set_default_registry)
 from .server import TelemetryServer  # noqa: F401
-from .trace import SpanContext, current, span, step_trace  # noqa: F401
+from .trace import (SpanContext, current, span, step_trace,  # noqa: F401
+                    use_span)
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "default_registry", "set_default_registry", "add_global_collector",
     "METRIC_NAME_RE",
     "TelemetryServer",
-    "trace", "SpanContext", "step_trace", "span", "current",
+    "trace", "SpanContext", "step_trace", "span", "current", "use_span",
+    "attribution", "flight_recorder",
+    "FlightRecorder", "set_flight_recorder", "record_failure",
 ]

@@ -37,7 +37,7 @@ from typing import Dict, Iterator, Optional
 
 from .. import profiler
 
-__all__ = ["SpanContext", "current", "step_trace", "span",
+__all__ = ["SpanContext", "current", "step_trace", "span", "use_span",
            "current_trace_args"]
 
 _current: "contextvars.ContextVar[Optional[SpanContext]]" = \
@@ -123,6 +123,26 @@ def step_trace(step, name: Optional[str] = None):
     label = name or f"step/{step}"
     ctx = SpanContext(_new_id(), _new_id(), None, label)
     return _activate(ctx, f"trace::{label}", profiler.CAT_TRACE)
+
+
+@contextlib.contextmanager
+def use_span(ctx: Optional[SpanContext]):
+    """Re-activate an EXISTING span on this thread, emitting no event of
+    its own — the cross-thread handoff closing the documented trace
+    boundary: a FeedPrefetcher producer converting a step's batch, a
+    serving worker delivering a dispatched batch, or a lazy
+    ``StepResult.fetches()`` materialized after its step's span exited
+    all stamp their profiler events with the OWNING step's ids instead
+    of whatever contextvar happens to be active (or none).
+    ``ctx=None`` is a no-op, so call sites need no conditional."""
+    if ctx is None:
+        yield None
+        return
+    token = _current.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _current.reset(token)
 
 
 def span(name: str):

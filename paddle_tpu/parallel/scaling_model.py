@@ -56,7 +56,9 @@ ICI_BW = 4.5e10      # bytes/s one-way per torus axis (45 GB/s)
 ICI_LAT = 1e-6       # s per ICI hop
 DCN_BW = 3.125e9     # bytes/s per chip (25 Gbit/s/chip host NIC share)
 DCN_LAT = 10e-6      # s per DCN hop
-PEAK_BF16 = 197e12   # FLOP/s
+# FLOP/s — canonical v5e bf16 peak lives with the live-MFU gauge so the
+# scaling model, profile_mfu and the paddle_tpu_mfu series can't drift
+from ..observability.attribution import PEAK_FLOPS_DEFAULT as PEAK_BF16
 
 # Measured single-chip anchors (round-4 chip runs, real v5e):
 # (unit, per-replica batch in that unit, measured units/sec/chip).

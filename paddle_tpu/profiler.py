@@ -29,6 +29,38 @@ _events_lock = threading.Lock()
 # Kept as a late-bound hook: the profiler must not import observability.
 _trace_args_provider: Optional[Callable[[], Optional[Dict]]] = None
 
+# Always-on event listeners: called with every CLOSED RecordEvent's
+# dict, even while the profiler itself is disabled. This is the feed
+# for the observability layer's live attribution (step-phase breakdown)
+# and the flight recorder's ring buffer — neither may depend on a user
+# having started a profiling session. Listeners must be cheap and must
+# not raise (exceptions are swallowed); with no listener installed the
+# disabled-profiler cost stays one list truthiness test.
+_event_listeners: List[Callable[[Dict], None]] = []
+_listeners_lock = threading.Lock()
+
+
+def add_event_listener(fn: Callable[[Dict], None]) -> None:
+    """Register ``fn(event_dict)`` to observe every closed RecordEvent
+    (profiler enabled or not). Idempotent and thread-safe: concurrent
+    registration of the same listener installs it exactly once."""
+    with _listeners_lock:
+        if fn not in _event_listeners:
+            _event_listeners.append(fn)
+
+
+def remove_event_listener(fn: Callable[[Dict], None]) -> None:
+    with _listeners_lock:
+        try:
+            _event_listeners.remove(fn)
+        except ValueError:
+            pass
+
+
+def has_event_listener(fn: Callable[[Dict], None]) -> bool:
+    with _listeners_lock:
+        return fn in _event_listeners
+
 
 def set_trace_args_provider(fn: Optional[Callable[[], Optional[Dict]]]):
     """Install a callable whose (dict) result is merged into each
@@ -44,14 +76,20 @@ CAT_SERVING = "serving"
 # event covers the backoff sleep before that retry attempt.
 CAT_RESILIENCE = "resilience"
 # Host/device pipelining spans (core/executor.py + trainer.py + reader
-# FeedPrefetcher). The four event names partition a training step's
-# host-side time so an A/B trace shows exactly where the host stalls:
+# FeedPrefetcher). The first four names partition a training step's
+# SERIAL host-side time (observability.attribution maps them to the
+# feed/dispatch/fetch_sync/prefetch_wait phases; anything else lands in
+# the device residual):
 #   pipeline::dispatch      - enqueueing the jitted step (async, cheap)
 #   pipeline::fetch_sync    - materializing fetched values to host
 #   pipeline::prefetch_wait - consumer waiting on the feed prefetcher
-#   pipeline::host_blocked  - explicit sync barriers (checkpoint snapshot,
-#                             Executor.synchronize) and inline
-#                             (un-prefetched) reader+feed assembly
+#   pipeline::host_blocked  - inline (un-prefetched) reader+feed assembly
+#   pipeline::sync_barrier  - explicit device barriers (checkpoint
+#                             snapshot, Executor.synchronize): device
+#                             drain, deliberately NOT a feed phase
+#   pipeline::prefetch_fill - producer-thread convert+upload; overlaps
+#                             device compute, so never part of the
+#                             serial step breakdown
 CAT_PIPELINE = "pipeline"
 # Per-attempt RPC spans from distributed/jsonrpc.py (rpc::<op>): one
 # event per wire attempt, so retried calls show as distinct spans that
@@ -81,21 +119,31 @@ class RecordEvent:
         return self
 
     def __exit__(self, *exc):
+        listeners = _event_listeners
+        if not _enabled and not listeners:
+            return False
+        ev = {"name": self.name, "ts": self.t0 * 1e6,
+              "dur": (time.perf_counter() - self.t0) * 1e6,
+              "ph": "X", "pid": 0, "tid": 0}
+        if self.cat:
+            ev["cat"] = self.cat
+        args = dict(self.args) if self.args else {}
+        if _trace_args_provider is not None:
+            targs = _trace_args_provider()
+            if targs:
+                args.update(targs)
+        if args:
+            ev["args"] = args
         if _enabled:
-            ev = {"name": self.name, "ts": self.t0 * 1e6,
-                  "dur": (time.perf_counter() - self.t0) * 1e6,
-                  "ph": "X", "pid": 0, "tid": 0}
-            if self.cat:
-                ev["cat"] = self.cat
-            args = dict(self.args) if self.args else {}
-            if _trace_args_provider is not None:
-                targs = _trace_args_provider()
-                if targs:
-                    args.update(targs)
-            if args:
-                ev["args"] = args
             with _events_lock:
                 _events.append(ev)
+        # snapshot: a concurrent remove_event_listener must not skip
+        # another listener mid-iteration
+        for fn in list(listeners):
+            try:
+                fn(ev)
+            except Exception:
+                pass  # a broken listener must never break the hot path
         return False
 
 

@@ -268,7 +268,13 @@ class FeedPrefetcher:
         `feed-prefetcher-*` thread outlives its loop);
       * consumer waits are recorded as `pipeline::prefetch_wait`
         profiler events (CAT_PIPELINE): with a fast-enough reader the
-        wait is ~0 and the input pipeline is off the critical path.
+        wait is ~0 and the input pipeline is off the critical path;
+      * producer-side convert+upload is recorded as
+        `pipeline::prefetch_fill` and, once the consumer has called
+        `adopt_span(ctx)`, stamped with that step span's trace ids
+        (the Trainer adopts each dispatch's root span) — overlapped
+        producer work is attributable to the step it overlaps instead
+        of starting an unattributed chain on its own thread.
     """
 
     _END = object()
@@ -285,7 +291,14 @@ class FeedPrefetcher:
         # construction instead of killing the producer thread before
         # its try block, which would leave the consumer blocked forever
         from ..resilience import faults
+        from ..observability import trace as obs_trace
+        from .. import profiler
         self._faults = faults
+        self._trace = obs_trace
+        self._profiler = profiler
+        # step span producer work is attributed to (set via adopt_span
+        # from the consuming loop; read once per batch on the producer)
+        self._span = None
         self._q: _queue.Queue = _queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._done = False
@@ -295,6 +308,15 @@ class FeedPrefetcher:
         self._thread.start()
 
     # -- producer ------------------------------------------------------
+    def adopt_span(self, ctx) -> None:
+        """Attribute subsequent producer-side work to ``ctx`` (a
+        SpanContext): convert+upload events are stamped with the owning
+        step's trace ids instead of running unattributed on the
+        producer thread. The Trainer calls this with each dispatch's
+        root span, so batch N+1's overlapped feed work is charged to
+        the most recent step."""
+        self._span = ctx
+
     def _fill(self):
         try:
             while not self._stop.is_set():
@@ -305,7 +327,12 @@ class FeedPrefetcher:
                     return
                 if self._fire_faults:
                     self._faults.fire("reader.next")
-                if not self._put(("feed", self._convert(raw))):
+                with self._trace.use_span(self._span):
+                    with self._profiler.RecordEvent(
+                            "pipeline::prefetch_fill",
+                            cat=self._profiler.CAT_PIPELINE):
+                        converted = self._convert(raw)
+                if not self._put(("feed", converted)):
                     return
         except BaseException as e:  # noqa: BLE001 — re-raised in consumer
             self._put(("err", e))

@@ -179,6 +179,7 @@ class CircuitBreaker:
     def record_failure(self) -> None:
         """A batch failed: re-open a half-open probe immediately, or
         open once the consecutive-failure streak hits the threshold."""
+        tripped = False
         with self._lock:
             self._consecutive_failures += 1
             if self._state == HALF_OPEN or (
@@ -188,6 +189,17 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
                 self._probe_budget = 0
                 self.opened_total += 1
+                tripped = True
+        if tripped:
+            # flight-recorder trigger (outside the breaker lock: the
+            # dump snapshots the registry, whose collector re-reads
+            # this breaker's state): the bundle holds the serving
+            # events leading up to the trip
+            from ..observability.flight_recorder import record_failure \
+                as _flight_dump
+            _flight_dump("circuit_open",
+                         context={"breaker": self._obs_label,
+                                  "opened_total": self.opened_total})
 
     def snapshot(self) -> Dict:
         with self._lock:

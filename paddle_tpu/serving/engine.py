@@ -21,6 +21,8 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from .. import profiler
+from ..observability import attribution as obs_attr
+from ..observability import trace as obs_trace
 from ..resilience import faults
 from ..resilience import health as health_mod
 from ..resilience.health import CircuitOpenError, HealthMonitor
@@ -208,11 +210,16 @@ class ServingEngine:
                     return
             t0 = time.monotonic()
             try:
-                with profiler.RecordEvent(
-                        f"serving::batch_dispatch[{batch.bucket_rows}]",
-                        cat=profiler.CAT_SERVING):
-                    faults.fire("serving.batch")
-                    res = self.model.run_direct(batch.feed, sync=False)
+                # per-batch root span (worker threads have no inherited
+                # context): dispatch events AND the StepResult's later
+                # fetch share this batch's trace ids
+                with obs_trace.span("serving/batch"):
+                    with profiler.RecordEvent(
+                            f"serving::batch_dispatch[{batch.bucket_rows}]",
+                            cat=profiler.CAT_SERVING):
+                        faults.fire("serving.batch")
+                        res = self.model.run_direct(batch.feed,
+                                                    sync=False)
             except BaseException as e:  # dispatch failed; keep serving
                 self._fail_batch(batch, e)
                 res = None
@@ -223,15 +230,23 @@ class ServingEngine:
     def _run_batch(self, batch: Batch):
         t0 = time.monotonic()
         try:
-            with profiler.RecordEvent(
-                    f"serving::batch_run[{batch.bucket_rows}]",
-                    cat=profiler.CAT_SERVING):
-                faults.fire("serving.batch")
-                fetches = self.model.run_direct(batch.feed)
+            # per-batch root span: serving workers run on their own
+            # threads with no inherited trace context
+            with obs_trace.span("serving/batch"):
+                with profiler.RecordEvent(
+                        f"serving::batch_run[{batch.bucket_rows}]",
+                        cat=profiler.CAT_SERVING):
+                    faults.fire("serving.batch")
+                    # dispatch async then materialize immediately: the
+                    # same run as sync=True, but the result carries THIS
+                    # dispatch's static cost — the executor-global
+                    # last_cost races with other workers' dispatches
+                    res = self.model.run_direct(batch.feed, sync=False)
+                    fetches = res.fetches()
         except BaseException as e:  # deliver failures, keep serving
             self._fail_batch(batch, e)
             return
-        self._complete(batch, fetches, t0)
+        self._complete(batch, fetches, t0, res.cost)
 
     def _deliver(self, batch: Batch, res, t0: float):
         """Materialize an async-dispatched batch's StepResult and hand
@@ -241,7 +256,10 @@ class ServingEngine:
         except BaseException as e:
             self._fail_batch(batch, e)
             return
-        self._complete(batch, fetches, t0)
+        # res.cost is THIS dispatch's static cost, frozen at dispatch —
+        # by delivery time the executor-global last_cost may belong to
+        # a later bucket (possibly another worker's)
+        self._complete(batch, fetches, t0, res.cost)
 
     def _fail_batch(self, batch: Batch, e: BaseException):
         self.metrics.errors.inc(len(batch.requests))
@@ -249,9 +267,18 @@ class ServingEngine:
         for req in batch.requests:
             req.future.set_exception(e)
 
-    def _complete(self, batch: Batch, fetches, t0: float):
+    def _complete(self, batch: Batch, fetches, t0: float, cost=None):
         t1 = time.monotonic()
         self.health.record_success()
+        if obs_attr.attribution_enabled():
+            # live MFU for THIS engine: static cost of THIS batch's
+            # dispatched executable (captured at dispatch — under
+            # async overlap executor.last_cost may already belong to
+            # the next batch's bucket) / batch wall time / device peak
+            if cost is not None and cost.flops and t1 > t0:
+                self.metrics.set_mfu(
+                    cost.flops / obs_attr.peak_flops() / (t1 - t0),
+                    cost.flops)
         for req, (i0, i1) in zip(batch.requests, batch.slices):
             out = []
             for f, per_row in zip(fetches, self._per_row_fetch):

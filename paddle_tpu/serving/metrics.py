@@ -97,6 +97,31 @@ class ServingMetrics:
         self.queue_wait_s = histogram(
             "paddle_tpu_serving_queue_wait_seconds",
             "Request wall time, submit to batch dispatch.")
+        # live attribution: MFU + static model FLOPs of this engine's
+        # compiled executable, published under the SAME families the
+        # trainer uses (job label distinguishes producers). Registered
+        # lazily at the first publication so the attribution kill
+        # switch leaves NO zero-valued mfu series behind (the engine
+        # never calls set_mfu while attribution is off).
+        self._attr_job = f"engine_{self.engine_label}"
+        self.mfu = None
+        self.model_flops = None
+
+    def set_mfu(self, mfu: float, flops: float) -> None:
+        """Engine callback after each completed batch: publish the live
+        MFU and the static per-batch FLOPs of the dispatched
+        executable."""
+        if self.mfu is None:
+            from ..observability import attribution as _attr
+            # same-parameter re-registration is idempotent, so a race
+            # between worker threads lands on the same family; mfu is
+            # assigned LAST because it is the guard — a concurrent
+            # worker that sees it non-None must find model_flops set
+            self.model_flops = _attr.model_flops_gauge(
+                self.registry, self._attr_job)
+            self.mfu = _attr.mfu_gauge(self.registry, self._attr_job)
+        self.mfu.set(mfu)
+        self.model_flops.set(flops)
 
     def stats(self, executor=None) -> Dict:
         """JSON-able snapshot; pass the engine's Executor to fold in
@@ -114,6 +139,9 @@ class ServingMetrics:
             "batch_rows": self.batch_rows.snapshot(),
             "latency_s": self.latency_s.snapshot(),
             "queue_wait_s": self.queue_wait_s.snapshot(),
+            "mfu": self.mfu.value if self.mfu is not None else 0.0,
+            "model_flops": self.model_flops.value
+            if self.model_flops is not None else 0.0,
         }
         if executor is not None:
             cs = dict(executor.cache_stats)

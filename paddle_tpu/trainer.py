@@ -230,6 +230,7 @@ class Trainer:
         to the metrics registry. step_seconds is host-side
         dispatch-to-dispatch wall time per batch: with async dispatch
         it measures sustained throughput, not device latency."""
+        from .observability import attribution as obs_attr
         from .observability import trace as obs_trace
         from .observability.registry import default_registry
 
@@ -258,6 +259,9 @@ class Trainer:
                 "feeds, but the prefetcher uploads each batch to device")
         reg = default_registry()
         obs_on = reg.enabled
+        # live attribution rides the SAME kill switches: the disabled
+        # registry (process-wide off) or PADDLE_TPU_ATTRIBUTION=0
+        attr_on = obs_on and obs_attr.attribution_enabled()
         if obs_on:
             m_steps = reg.counter(
                 "paddle_tpu_train_steps_total",
@@ -271,6 +275,13 @@ class Trainer:
                 "paddle_tpu_train_prefetch_depth",
                 "FeedPrefetcher depth of the current train() call "
                 "(0 = inline feed assembly).").set(prefetch)
+        if attr_on:
+            m_mfu = obs_attr.mfu_gauge(reg, "train")
+            m_flops = obs_attr.model_flops_gauge(reg, "train")
+            m_phase = obs_attr.phase_histogram(reg)
+            # reset the phase window: events from start()/warmup must
+            # not leak into the first step's breakdown
+            obs_attr.drain_phases()
 
         def _stackable(feeds):
             if len(feeds) < 2:
@@ -339,6 +350,13 @@ class Trainer:
                     # really is the uninstrumented loop.
                     with (obs_trace.step_trace(self.step) if obs_on
                           else contextlib.nullcontext()) as root:
+                        if prefetcher is not None and root is not None:
+                            # cross-thread span handoff: producer-side
+                            # convert+upload work is stamped with the
+                            # CURRENT step's span (the most recent
+                            # dispatch — batch N+1 converts while step
+                            # N computes)
+                            prefetcher.adopt_span(root)
                         group = []
                         for _ in range(k):
                             try:
@@ -412,9 +430,35 @@ class Trainer:
                         self._maybe_checkpoint(advanced=len(group))
                     if obs_on:
                         now = time.monotonic()
+                        wall = now - t_prev
                         m_steps.inc(len(group))
-                        m_step_s.record((now - t_prev) / len(group))
+                        m_step_s.record(wall / len(group))
                         t_prev = now
+                        if attr_on:
+                            # phase breakdown: measured host phases
+                            # since the last dispatch + the device
+                            # residual — the five phases of one step
+                            # sum to its wall time (device clamps at 0
+                            # when overlapped host work exceeds it)
+                            phases = obs_attr.drain_phases()
+                            host = sum(phases.values())
+                            phases["device"] = max(0.0, wall - host)
+                            for ph in obs_attr.PHASES:
+                                m_phase.labels(phase=ph).record(
+                                    phases.get(ph, 0.0) / len(group))
+                            # the dispatch's OWN cost off the result:
+                            # exe.last_cost may already belong to a
+                            # different program (an event handler
+                            # calling trainer.test() runs the pruned
+                            # eval clone on this same executor)
+                            cost = getattr(res, "cost", None)
+                            if cost is not None and cost.flops:
+                                step_s = wall / len(group)
+                                m_flops.set(float(cost.flops))
+                                if step_s > 0:
+                                    m_mfu.set(cost.flops
+                                              / obs_attr.peak_flops()
+                                              / step_s)
                     dispatch_id += 1
                     if len(group) < k:
                         break
@@ -458,6 +502,12 @@ class Trainer:
                     "Checkpoint saves that failed after retries "
                     "(training continued; previous checkpoint remains "
                     "the resume point).").inc()
+                # flight-recorder trigger: the dump carries the events
+                # and metrics leading up to the failed save
+                from .observability.flight_recorder import record_failure
+                record_failure("checkpoint_failure", exc=e,
+                               context={"step": self.step,
+                                        "dirname": cc.dirname})
                 if cc.on_error == "raise":
                     raise
                 import warnings

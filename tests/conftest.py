@@ -3,9 +3,22 @@ sharding/mesh tests run without TPU hardware (the driver separately
 dry-runs the multi-chip path via __graft_entry__.dryrun_multichip)."""
 import os
 import sys
+import tempfile
 
 os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
     " --xla_force_host_platform_device_count=8"
+
+# Point the process-default flight recorder (built ENABLED at
+# paddle_tpu.observability import) at a per-run private dir: expected-
+# failure tests (golden verifier defects, chaos faults) trigger real
+# dumps, and pruning is per-pid so bundles in the host-shared default
+# dir would accumulate across runs forever.
+if "PADDLE_TPU_FLIGHT_DIR" not in os.environ:
+    import atexit
+    import shutil
+    _flight_dir = tempfile.mkdtemp(prefix="pt_test_flightrec_")
+    os.environ["PADDLE_TPU_FLIGHT_DIR"] = _flight_dir
+    atexit.register(shutil.rmtree, _flight_dir, ignore_errors=True)
 
 import jax  # noqa: E402
 

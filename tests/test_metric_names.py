@@ -54,8 +54,17 @@ def test_registry_names_and_help_after_smoke_run(tmp_path):
                      "paddle_tpu_train_step_seconds",
                      "paddle_tpu_compile_cache_misses_total",
                      "paddle_tpu_serving_requests_total",
-                     "paddle_tpu_circuit_breaker_state"):
+                     "paddle_tpu_circuit_breaker_state",
+                     # ISSUE 6: always-on attribution families
+                     "paddle_tpu_mfu",
+                     "paddle_tpu_model_flops",
+                     "paddle_tpu_step_phase_seconds"):
         assert expected in names, f"smoke run did not publish {expected}"
+    # the attribution families carry both producers: the trainer's
+    # job="train" series and the engine's job="engine_<n>" series
+    mfu_jobs = {key[0] for key, _ in reg.get("paddle_tpu_mfu").samples()}
+    assert "train" in mfu_jobs
+    assert any(j.startswith("engine_") for j in mfu_jobs), mfu_jobs
     for fam in fams:
         assert METRIC_NAME_RE.match(fam.name), (
             f"metric {fam.name!r} violates the naming contract "

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""lint_ir: run the static ProgramDesc verifier from the command line.
+"""lint_ir: run the static ProgramDesc verifier (or the cost model)
+from the command line.
 
 Two input modes:
 
@@ -12,8 +13,15 @@ Two input modes:
       test suite exercises) and verify its (main, startup) pair —
       including uninitialized-persistable detection, which needs both.
 
+Either mode also supports --cost: instead of verifying, print the
+static cost-model table (per-op FLOPs / bytes accessed / parameter
+bytes plus program totals, analysis/cost_model.py) — offline
+attribution with no step executed. --batch binds dynamic (-1) dims;
+--json emits the machine-readable form.
+
 Exit status: 0 when the verifier finds no error-severity diagnostics,
 1 when it does (warnings never fail the lint; --strict promotes them).
+--cost always exits 0 unless the model cannot be loaded/built.
 tests/test_lint_cli.py drives every named network through this tool so
 CI keeps the suite's programs verifier-clean.
 """
@@ -184,21 +192,42 @@ def lint_network(name: str, retrace: bool = True):
         passes=passes, program_label=f"network {name!r}")
 
 
-def lint_model_dir(dirname: str):
-    """Load a save_inference_model directory and verify the frozen
-    program (private scope: the process global scope is untouched)."""
+def _load_model_dir(dirname: str):
+    """Load a save_inference_model directory into a private scope (the
+    process global scope is untouched); returns (program, feed names,
+    fetch names)."""
     import paddle_tpu as pt
-    from paddle_tpu import analysis, io
+    from paddle_tpu import io
 
     scope = pt.Scope()
     exe = pt.Executor()
     with pt.scope_guard(scope):
         prog, feed_names, fetch_vars, _meta = io.load_inference_model(
             dirname, exe, return_meta=True)
+    return prog, feed_names, [v.name for v in fetch_vars]
+
+
+def lint_model_dir(dirname: str):
+    """Load a save_inference_model directory and verify the frozen
+    program."""
+    from paddle_tpu import analysis
+    prog, feed_names, fetch_names = _load_model_dir(dirname)
     return analysis.verify_program(
-        prog, feed_names=feed_names,
-        fetch_names=[v.name for v in fetch_vars],
+        prog, feed_names=feed_names, fetch_names=fetch_names,
         program_label=f"model dir {dirname!r}")
+
+
+def cost_report(network: str = None, model_dir: str = None,
+                batch: int = 1):
+    """Build/load the target program and return its ProgramCost."""
+    from paddle_tpu.analysis import cost_model
+    if network:
+        main, _startup, _feeds, _fetches = NETWORKS[network]()
+        prog, label = main, f"network {network!r}"
+    else:
+        prog, _feeds, _fetches = _load_model_dir(model_dir)
+        label = f"model dir {model_dir!r}"
+    return cost_model.program_cost(prog, batch=batch, label=label)
 
 
 def main(argv=None) -> int:
@@ -224,6 +253,16 @@ def main(argv=None) -> int:
                     help="network mode: skip the abstract-inference "
                          "re-trace, rely on build-time markers (the "
                          "executor gate's fast mode)")
+    ap.add_argument("--cost", action="store_true",
+                    help="print the static cost-model table (per-op "
+                         "FLOPs/bytes/params + totals) instead of "
+                         "running the verifier")
+    ap.add_argument("--batch", type=int, default=1,
+                    help="--cost: batch size bound to dynamic (-1) "
+                         "dims (default 1)")
+    ap.add_argument("--limit", type=int, default=20,
+                    help="--cost: table rows to print (heaviest "
+                         "first; default 20)")
     args = ap.parse_args(argv)
 
     if args.list_networks:
@@ -232,6 +271,13 @@ def main(argv=None) -> int:
         return 0
     if bool(args.model_dir) == bool(args.network):
         ap.error("give exactly one of: a model dir, or --network NAME")
+
+    if args.cost:
+        cost = cost_report(network=args.network,
+                           model_dir=args.model_dir, batch=args.batch)
+        print(cost.to_json(indent=2) if args.json
+              else cost.table(limit=args.limit))
+        return 0
 
     if args.network:
         report = lint_network(args.network, retrace=not args.no_retrace)
