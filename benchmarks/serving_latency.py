@@ -9,6 +9,23 @@ compile-cache hit rate that the bucketing exists to maximize.
 
     python benchmarks/serving_latency.py --clients 8 --duration 10 \
         --max_batch 32 --max_latency_ms 5
+
+Arms (ISSUE 7):
+
+    --arm baseline   the closed-loop harness above (default)
+    --arm overload   calibrate capacity closed-loop, then offer ~2x
+                     capacity open-loop twice — shedding OFF (no
+                     admission: the queue and every admitted request's
+                     p99 grow with the backlog) vs shedding ON
+                     (admission limits: bounded admitted-request p99, a
+                     shed rate instead of a latency collapse, and
+                     paddle_tpu_serving_shed_total accounting for every
+                     rejected request)
+    --arm hotswap    hot-swap a new model version through a ModelHost
+                     mid-traffic and report swap blackout time (max gap
+                     between successful completions around the swap —
+                     ~0 target), client-visible errors (0 target), shed
+                     rate, and admitted-request p99
 """
 from __future__ import annotations
 
@@ -42,8 +59,290 @@ def freeze_mlp(dirname, in_dim=784, hidden=256, classes=10):
     return dirname
 
 
+def _percentiles_ms(latencies):
+    lat = np.asarray(latencies) if latencies else np.zeros(1)
+    return {
+        "p50": round(float(np.percentile(lat, 50)) * 1e3, 3),
+        "p90": round(float(np.percentile(lat, 90)) * 1e3, 3),
+        "p99": round(float(np.percentile(lat, 99)) * 1e3, 3),
+        "mean": round(float(lat.mean()) * 1e3, 3),
+    }
+
+
+def _calibrate_capacity(engine, in_dim, rows, clients, seconds):
+    """Closed-loop throughput with `clients` clients = the engine's
+    sustainable capacity (requests/s)."""
+    stop = threading.Event()
+    done = [0]
+    lock = threading.Lock()
+
+    def client(seed):
+        x = np.random.RandomState(seed).rand(rows, in_dim) \
+            .astype(np.float32)
+        while not stop.is_set():
+            try:
+                engine.predict({"x": x}, timeout=60)
+            except Exception:
+                continue
+            with lock:
+                done[0] += 1
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    return done[0] / (time.monotonic() - t0)
+
+
+def _drive_open_loop(submit, in_dim, rows, offered_rps, seconds,
+                     waiters=8, queue_probe=None):
+    """Offer a FIXED request rate regardless of completions (the
+    overload shape a closed loop cannot produce: a closed loop slows
+    down with the server, an open loop keeps arriving). Returns
+    (admitted latencies, sheds-by-type, offered, completed, errored,
+    peak queue rows)."""
+    import queue as queue_mod
+
+    inflight = queue_mod.Queue()
+    lock = threading.Lock()
+    latencies, shed, completed, errored = [], {}, [0], [0]
+    x = np.random.RandomState(0).rand(rows, in_dim).astype(np.float32)
+
+    def waiter():
+        while True:
+            item = inflight.get()
+            if item is None:
+                return
+            fut, t_submit = item
+            try:
+                fut.result(timeout=120)
+            except Exception:
+                with lock:
+                    errored[0] += 1
+                continue
+            dt = time.monotonic() - t_submit
+            with lock:
+                latencies.append(dt)
+                completed[0] += 1
+
+    wthreads = [threading.Thread(target=waiter, daemon=True)
+                for _ in range(waiters)]
+    for t in wthreads:
+        t.start()
+    interval = 1.0 / offered_rps
+    offered = 0
+    peak_queue = 0
+    t_end = time.monotonic() + seconds
+    next_t = time.monotonic()
+    while time.monotonic() < t_end:
+        now = time.monotonic()
+        if now < next_t:
+            time.sleep(min(interval, next_t - now))
+            continue
+        next_t += interval
+        offered += 1
+        if queue_probe is not None and offered % 64 == 0:
+            peak_queue = max(peak_queue, queue_probe())
+        t_submit = time.monotonic()
+        try:
+            fut = submit({"x": x})
+        except Exception as e:
+            with lock:
+                shed[type(e).__name__] = shed.get(type(e).__name__,
+                                                  0) + 1
+            continue
+        inflight.put((fut, t_submit))
+    for _ in wthreads:
+        inflight.put(None)
+    for t in wthreads:
+        t.join(timeout=180)
+    return latencies, shed, offered, completed[0], errored[0], peak_queue
+
+
+def run_overload_arm(args, serving, model_dir):
+    """Offered load ~2x capacity, shedding OFF vs ON."""
+    # -- calibrate on a throwaway engine -------------------------------
+    model = serving.load(model_dir)
+    engine = model.serve(serving.BatchingConfig(
+        max_batch_size=args.max_batch,
+        max_latency_ms=args.max_latency_ms,
+        queue_capacity_rows=1_000_000))
+    engine.start(warmup=True)
+    # Calibrate with enough in-flight requests to keep batches full:
+    # a closed loop with few clients is latency-bound (deadline
+    # flushes of small batches) and reads far below the engine's real
+    # sustainable rate, so "2x capacity" would not actually overload.
+    cal_clients = max(args.clients,
+                      (4 * args.max_batch) // max(1, args.rows))
+    capacity = _calibrate_capacity(engine, args.in_dim, args.rows,
+                                   cal_clients,
+                                   max(2.0, args.duration / 4))
+    engine.stop(drain=True, timeout=120)
+    offered = 2.0 * capacity
+
+    arms = {}
+    for shedding in (False, True):
+        m = serving.load(model_dir)
+        admission = None
+        if shedding:
+            # the queue-depth bound is the primary limit (~0.25s of
+            # backlog at capacity → admitted p99 bounded near that);
+            # the rolling p99 read from the serving latency histogram
+            # is the safety net ABOVE it, catching slow-model overload
+            # a row count misses. Making the p99 limit tighter than
+            # the depth-implied latency would have the two limits
+            # fight (shed-everything oscillation).
+            admission = serving.AdmissionConfig(
+                max_queue_rows=max(args.max_batch,
+                                   int(capacity * args.rows * 0.25)),
+                max_p99_s=1.0,
+                shed_storm_threshold=None)
+        eng = m.serve(serving.BatchingConfig(
+            max_batch_size=args.max_batch,
+            max_latency_ms=args.max_latency_ms,
+            queue_capacity_rows=1_000_000), admission=admission)
+        eng.start(warmup=True)
+        t0 = time.monotonic()
+        lats, shed, n_offered, n_completed, n_errored, peak_queue = \
+            _drive_open_loop(eng.submit, args.in_dim, args.rows,
+                             offered, args.duration,
+                             queue_probe=lambda: eng.batcher
+                             .pending_rows)
+        drive_s = time.monotonic() - t0
+        t_drain = time.monotonic()
+        eng.stop(drain=True, timeout=600)
+        drain_s = time.monotonic() - t_drain
+        n_shed = sum(shed.values())
+        shed_metric = sum(eng.metrics.shed_by_reason().values())
+        arms["shedding_on" if shedding else "shedding_off"] = {
+            "offered_rps": round(n_offered / drive_s, 2),
+            "admitted_rps": round((n_offered - n_shed) / drive_s, 2),
+            "completed": n_completed,
+            "errored": n_errored,
+            "shed": n_shed,
+            "shed_rate": round(n_shed / n_offered, 4) if n_offered
+            else 0.0,
+            "shed_by_exception": shed,
+            "shed_total_metric": shed_metric,
+            "shed_ledger_accounts_all": shed_metric == n_shed,
+            "admitted_latency_ms": _percentiles_ms(lats),
+            "peak_queue_rows": peak_queue,
+            "drain_s": round(drain_s, 3),
+            "admission": eng.stats()["admission"],
+        }
+    return {
+        "benchmark": "serving_latency",
+        "arm": "overload",
+        "clients": args.clients,
+        "rows_per_request": args.rows,
+        "max_batch": args.max_batch,
+        "max_latency_ms": args.max_latency_ms,
+        "duration_s": args.duration,
+        "capacity_rps": round(capacity, 2),
+        "offered_rps_target": round(offered, 2),
+        "arms": arms,
+    }
+
+
+def run_hotswap_arm(args, serving, model_dir):
+    """Hot-swap under traffic: blackout time, shed rate, admitted p99."""
+    model_dir2 = tempfile.mkdtemp(prefix="serving_bench_v2_")
+    freeze_mlp(model_dir2, in_dim=args.in_dim)
+    host = serving.ModelHost(
+        model_dir, version="v1",
+        config=serving.BatchingConfig(
+            max_batch_size=args.max_batch,
+            max_latency_ms=args.max_latency_ms,
+            # the hard backstop sits far ABOVE the admission limit so
+            # overload sheds as ServiceOverloadedError (counted), never
+            # as QueueFullError (which the client loop would book as a
+            # failure against the arm's zero-failures target)
+            queue_capacity_rows=1_000_000),
+        admission=serving.AdmissionConfig(
+            max_queue_rows=4096, shed_storm_threshold=None)).start()
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    lat, success_t, failed, shed = [], [], [0], [0]
+
+    def client(seed):
+        x = np.random.RandomState(seed).rand(args.rows, args.in_dim) \
+            .astype(np.float32)
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                host.predict({"x": x}, timeout=60)
+            except serving.ServiceOverloadedError:
+                with lock:
+                    shed[0] += 1
+                continue
+            except Exception:
+                with lock:
+                    failed[0] += 1
+                continue
+            t1 = time.monotonic()
+            with lock:
+                lat.append(t1 - t0)
+                success_t.append(t1)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.clients)]
+    for t in threads:
+        t.start()
+    lead = args.duration / 3
+    time.sleep(lead)                      # steady state on v1
+    t_swap0 = time.monotonic()
+    report = host.swap(model_dir2, version="v2",
+                       canary_fraction=args.canary_fraction,
+                       canary_min_requests=20,
+                       canary_timeout_s=60.0)
+    t_swap1 = time.monotonic()
+    time.sleep(lead)                      # steady state on v2
+    stop.set()
+    for t in threads:
+        t.join(timeout=120)
+    host.stop(drain=True, timeout=120)
+
+    with lock:
+        ts = sorted(success_t)
+    # blackout = the largest window with NO successful completion
+    # around the swap; compare to the steady-state gap before it
+    def max_gap(lo, hi):
+        pts = [t for t in ts if lo <= t <= hi]
+        if len(pts) < 2:
+            return hi - lo
+        gaps = np.diff(np.asarray(pts))
+        return float(gaps.max()) if len(gaps) else 0.0
+
+    swap_gap_s = max_gap(t_swap0 - 0.25, t_swap1 + 0.25)
+    steady_gap_s = max_gap(t_swap0 - lead, t_swap0 - 0.25)
+    return {
+        "benchmark": "serving_latency",
+        "arm": "hotswap",
+        "clients": args.clients,
+        "canary_fraction": args.canary_fraction,
+        "swap_report": report,
+        "swap_wall_s": round(t_swap1 - t_swap0, 3),
+        "swap_blackout_ms": round(swap_gap_s * 1e3, 3),
+        "steady_state_max_gap_ms": round(steady_gap_s * 1e3, 3),
+        "requests_completed": len(ts),
+        "requests_failed": failed[0],
+        "requests_shed": shed[0],
+        "shed_rate": round(shed[0] / max(1, len(ts) + shed[0]
+                                         + failed[0]), 4),
+        "admitted_latency_ms": _percentiles_ms(lat),
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument("--arm", choices=["baseline", "overload", "hotswap"],
+                   default="baseline")
     p.add_argument("--clients", type=int, default=8,
                    help="closed-loop client threads")
     p.add_argument("--duration", type=float, default=10.0,
@@ -53,12 +352,22 @@ def main():
     p.add_argument("--max_batch", type=int, default=32)
     p.add_argument("--max_latency_ms", type=float, default=5.0)
     p.add_argument("--in_dim", type=int, default=784)
+    p.add_argument("--canary_fraction", type=float, default=0.1,
+                   help="hotswap arm: canary routing fraction")
     args = p.parse_args()
 
     from paddle_tpu import serving
 
     model_dir = tempfile.mkdtemp(prefix="serving_bench_")
     freeze_mlp(model_dir, in_dim=args.in_dim)
+    if args.arm == "overload":
+        print(json.dumps(run_overload_arm(args, serving, model_dir),
+                         indent=2))
+        return
+    if args.arm == "hotswap":
+        print(json.dumps(run_hotswap_arm(args, serving, model_dir),
+                         indent=2))
+        return
     model = serving.load(model_dir)
     engine = model.serve(serving.BatchingConfig(
         max_batch_size=args.max_batch,

@@ -101,9 +101,12 @@ def load_persistables(executor, dirname, main_program=None, filename=None):
 
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
-                         params_filename=None):
+                         params_filename=None, model_version=None):
     """Freeze program + params for inference (reference: io.py:298 +
-    framework/prune.cc pruning)."""
+    framework/prune.cc pruning). `model_version` is an optional deploy
+    identity stamped into the artifact metadata — the serving lifecycle
+    (ModelHost hot-swap, the model_version gauge) reports it; absent on
+    artifacts saved before versioning existed."""
     program = main_program or default_main_program()
     os.makedirs(dirname, exist_ok=True)
     fetch_names = [t.name for t in target_vars]
@@ -136,6 +139,20 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         pruned, feeded_var_names, fetch_names)
     meta["feed_specs"] = feed_specs
     meta["fetch_specs"] = fetch_specs
+    version_path = os.path.join(dirname, "__version__")
+    if model_version is not None:
+        meta["model_version"] = str(model_version)
+        # unlike feed_specs, the deploy identity cannot be re-derived
+        # from the program if the native PTIR writer drops the unknown
+        # top-level key — a plain-text sidecar guarantees the
+        # round-trip on any writer
+        with open(version_path, "w") as f:
+            f.write(str(model_version))
+    elif os.path.exists(version_path):
+        # re-freezing WITHOUT a version into a dir that had one: a
+        # stale sidecar would stamp the previous artifact's identity
+        # onto the new weights
+        os.remove(version_path)
     try:
         from .native import ProgramIR
         ProgramIR.from_json(json.dumps(meta)).save(
@@ -172,7 +189,8 @@ def load_inference_model(dirname, executor, model_filename=None,
             meta = json.load(f)
         meta = meta.get("program", meta) | {
             k: meta[k] for k in ("feed_names", "fetch_names",
-                                 "feed_specs", "fetch_specs") if k in meta}
+                                 "feed_specs", "fetch_specs",
+                                 "model_version") if k in meta}
     from .core import ir
     prog = Program()
     prog.desc = ir.Program.from_dict(meta)
@@ -189,8 +207,15 @@ def load_inference_model(dirname, executor, model_filename=None,
     else:  # saved before specs were written, or dropped by the PTIR writer
         feed_specs, fetch_specs = inference_model_specs(
             prog, meta["feed_names"], meta["fetch_names"])
+    model_version = meta.get("model_version")
+    if model_version is None:
+        vpath = os.path.join(dirname, "__version__")
+        if os.path.exists(vpath):  # PTIR writer dropped the meta key
+            with open(vpath) as f:
+                model_version = f.read().strip() or None
     return prog, meta["feed_names"], fetch_vars, {
-        "feed_specs": feed_specs, "fetch_specs": fetch_specs}
+        "feed_specs": feed_specs, "fetch_specs": fetch_specs,
+        "model_version": model_version}
 
 
 def _prune(program: Program, feed_names, fetch_names) -> Program:

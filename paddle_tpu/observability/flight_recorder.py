@@ -26,6 +26,12 @@ the resilience fault points):
                          (fault point checkpoint.write)
     circuit_open         the serving circuit breaker tripped open
     verification_error   a program failed static verification at a gate
+    rollback             a serving hot-swap rolled back to the prior
+                         model version (breaker trip, canary error
+                         rate, or a swap-machinery failure)
+    shed_storm           admission control shed more than the
+                         configured number of requests inside its
+                         rolling window — sustained overload
 
 Nothing is ever written on a clean run. Dumps are rate-limited per
 reason (``min_interval_s``) and pruned to the ``max_dumps`` newest, so
@@ -55,7 +61,7 @@ DEFAULT_MIN_INTERVAL_S = 1.0
 
 _DUMPS_HELP = ("Flight-recorder bundles written, by failure reason "
                "(nan_fetch, checkpoint_failure, circuit_open, "
-               "verification_error).")
+               "verification_error, rollback, shed_storm).")
 
 
 def _default_dump_dir() -> str:

@@ -203,6 +203,12 @@ class _NullInstrument:
     def retain(self, keys):
         pass
 
+    def discard(self, key):
+        pass
+
+    def samples(self):
+        return []
+
     def inc(self, n=1):
         pass
 
@@ -270,6 +276,14 @@ class _Family:
         with self._lock:
             for k in [k for k in self._children if k not in keep]:
                 del self._children[k]
+
+    def discard(self, key: Tuple[str, ...]):
+        """Drop ONE child series if present — the inverse of labels()
+        for producers that retire a label value (e.g. a ModelHost
+        dropping a retired engine's series so long-lived swap cycles
+        do not grow scrape cardinality without bound)."""
+        with self._lock:
+            self._children.pop(tuple(str(k) for k in key), None)
 
     def samples(self) -> List[Tuple[Tuple[str, ...], object]]:
         with self._lock:

@@ -13,6 +13,13 @@ Registered fault points (armed sites, see each caller):
     master.rpc          distributed/master.py MasterClient per-RPC attempt
     pserver.push        distributed/pserver.py PServerClient push attempt
     serving.batch       serving/engine.py per-batch model run
+    serving.swap        serving/lifecycle.py ModelHost.swap phase
+                        boundaries (candidate load, post-precompile,
+                        pre-cutover) — a fault here must roll the swap
+                        back with zero client-visible failures
+    serving.admission   serving/admission.py per-submit admission check
+                        — a fault here surfaces as a fast shed
+                        (ServiceOverloadedError), never a hang
     reader.next         reader/__init__.py batch() per yielded batch,
                         and FeedPrefetcher per pulled batch (its
                         producer thread — faults propagate to the
@@ -49,7 +56,8 @@ __all__ = ["FaultInjector", "FaultError", "fire", "active", "FAULT_POINTS"]
 #: set unless the rule is registered with `unchecked=True`.
 FAULT_POINTS = frozenset({
     "checkpoint.write", "checkpoint.read", "master.rpc", "pserver.push",
-    "serving.batch", "reader.next", "dataset.download",
+    "serving.batch", "serving.swap", "serving.admission", "reader.next",
+    "dataset.download",
 })
 
 _active: Optional["FaultInjector"] = None

@@ -83,25 +83,66 @@ class CircuitOpenError(RuntimeError):
 
 
 class CircuitBreaker:
-    """Consecutive-failure breaker over batch outcomes.
+    """Breaker over batch outcomes with two trip modes.
+
+    Consecutive mode (always on): `failure_threshold` consecutive
+    failures open the circuit — the broken-model case, where every
+    batch fails.
+
+    Windowed error-*rate* mode (on when `error_rate_threshold` is set):
+    the failure fraction over the last `error_rate_window` outcomes
+    reaching the threshold opens the circuit, once at least
+    `error_rate_min_samples` outcomes are in the window. This catches
+    the slow trickle — poisoned rows failing one batch in three never
+    build a consecutive streak, but they do hold a 33% error rate. The
+    window is cleared on every open (stale failures must not instantly
+    re-trip the circuit a successful half-open probe just closed).
 
     failure_threshold: consecutive failures that open the circuit.
     reset_timeout_s:   open -> half-open cooldown.
     half_open_probes:  requests admitted while half-open (the probe
                        budget; replenished on each open -> half-open
                        transition).
+    error_rate_threshold: failure fraction in [0, 1] that opens the
+                       circuit (None = rate mode off).
+    error_rate_window: rolling outcome window for the rate.
+    error_rate_min_samples: outcomes required before the rate can trip
+                       (a floor, so one failure in an empty window is
+                       not a 100% error rate).
     clock:             injectable monotonic clock for tests.
     """
 
     def __init__(self, failure_threshold: int = 5,
                  reset_timeout_s: float = 5.0,
                  half_open_probes: int = 1,
+                 error_rate_threshold: Optional[float] = None,
+                 error_rate_window: int = 64,
+                 error_rate_min_samples: int = 16,
                  clock: Callable[[], float] = time.monotonic):
         if failure_threshold < 1:
             raise ValueError("failure_threshold must be >= 1")
+        if error_rate_threshold is not None and \
+                not 0.0 < error_rate_threshold <= 1.0:
+            raise ValueError("error_rate_threshold must be in (0, 1]")
+        if error_rate_min_samples < 1:
+            raise ValueError("error_rate_min_samples must be >= 1")
+        if error_rate_threshold is not None and \
+                int(error_rate_window) < int(error_rate_min_samples):
+            # the deque's maxlen would cap the sample count BELOW the
+            # floor, so the rate mode the caller explicitly enabled
+            # could never trip — refuse instead of silently disarming
+            raise ValueError(
+                f"error_rate_window ({error_rate_window}) must be >= "
+                f"error_rate_min_samples ({error_rate_min_samples}); "
+                "a window smaller than the min-samples floor can never "
+                "accumulate enough outcomes to trip")
         self.failure_threshold = int(failure_threshold)
         self.reset_timeout_s = float(reset_timeout_s)
         self.half_open_probes = int(half_open_probes)
+        self.error_rate_threshold = error_rate_threshold
+        self.error_rate_min_samples = int(error_rate_min_samples)
+        self._window: "collections.deque[bool]" = collections.deque(
+            maxlen=int(error_rate_window))
         self._clock = clock
         self._lock = threading.Lock()
         self._state = CLOSED
@@ -168,27 +209,53 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         """A batch completed: a half-open probe's success closes the
-        circuit; while OPEN, a straggler batch admitted before the trip
-        only resets the streak (cooldown + probe still required)."""
+        circuit; while OPEN, a straggler batch dispatched before the
+        trip is not evidence about recovery — ignored (cooldown +
+        probe still required)."""
         with self._lock:
+            if self._state == OPEN:
+                return
             self._consecutive_failures = 0
+            self._window.append(True)
             if self._state == HALF_OPEN:
                 self._state = CLOSED
                 self._opened_at = None
 
+    def _error_rate_locked(self) -> float:
+        n = len(self._window)
+        return (1.0 - sum(self._window) / n) if n else 0.0
+
     def record_failure(self) -> None:
         """A batch failed: re-open a half-open probe immediately, or
-        open once the consecutive-failure streak hits the threshold."""
+        open once the consecutive-failure streak hits the threshold —
+        or, in rate mode, once the windowed error rate does."""
         tripped = False
         with self._lock:
+            if self._state == OPEN:
+                # straggler from a batch dispatched before the trip:
+                # the circuit is already open and the freshly-cleared
+                # window must not be poisoned, or the first ordinary
+                # failure after a successful probe would instantly
+                # re-trip over ~100% stale history
+                return
             self._consecutive_failures += 1
+            self._window.append(False)
+            rate_trip = (
+                self.error_rate_threshold is not None
+                and len(self._window) >= self.error_rate_min_samples
+                and self._error_rate_locked() >= self.error_rate_threshold)
             if self._state == HALF_OPEN or (
                     self._state == CLOSED and
-                    self._consecutive_failures >= self.failure_threshold):
+                    (self._consecutive_failures >= self.failure_threshold
+                     or rate_trip)):
                 self._state = OPEN
                 self._opened_at = self._clock()
                 self._probe_budget = 0
                 self.opened_total += 1
+                # the window restarts with the circuit: outcomes from
+                # before the trip must not re-trip it right after a
+                # successful probe closes it
+                self._window.clear()
                 tripped = True
         if tripped:
             # flight-recorder trigger (outside the breaker lock: the
@@ -209,6 +276,9 @@ class CircuitBreaker:
                 "consecutive_failures": self._consecutive_failures,
                 "failure_threshold": self.failure_threshold,
                 "reset_timeout_s": self.reset_timeout_s,
+                "error_rate_threshold": self.error_rate_threshold,
+                "window_error_rate": round(self._error_rate_locked(), 6),
+                "window_samples": len(self._window),
                 "opened_total": self.opened_total,
                 "shed_total": self.shed_total,
             }

@@ -16,25 +16,43 @@ Quickstart::
     print(engine.stats())              # JSON-able metrics snapshot
     engine.stop()                      # drains in-flight requests
 
+Production lifecycle::
+
+    host = serving.ModelHost("model_v1_dir",
+                             admission=serving.AdmissionConfig(
+                                 max_queue_rows=512)).start()
+    out, = host.predict({"x": batch})
+    report = host.swap("model_v2_dir", canary_fraction=0.1)
+    host.stop()
+
 Module map: `model.ServableModel` (frozen program + pinned weights),
 `batcher.DynamicBatcher` (bucket padding, deadline/max-batch flush,
 backpressure), `engine.ServingEngine` (workers, warmup, drain, and a
-consecutive-failure circuit breaker — open = submit() fast-fails with
-CircuitOpenError, recovery via half-open probe; resilience/health.py),
+circuit breaker — open = submit() fast-fails with CircuitOpenError,
+recovery via half-open probe; resilience/health.py),
+`admission.AdmissionController` (queue-depth / rolling-p99 load
+shedding with ServiceOverloadedError), `lifecycle.ModelHost` (atomic
+weight hot-swap: verifier deploy gate, shared-cache precompile, canary
+fraction with stable-fallback, automatic rollback),
 `metrics.ServingMetrics` (counters/histograms + stats()).
 """
 from ..resilience.health import (CircuitBreaker, CircuitOpenError,  # noqa
                                  HealthMonitor)
+from .admission import (AdmissionConfig, AdmissionController,  # noqa
+                        ServiceOverloadedError)
 from .batcher import (BatchingConfig, DynamicBatcher,  # noqa
                       QueueFullError, ServingFuture, ServingStopped)
 from .engine import ServingEngine  # noqa
+from .lifecycle import ModelHost, SwapError  # noqa
 from .metrics import ServingMetrics  # noqa
 from .model import ServableModel  # noqa
 
 __all__ = ["load", "ServableModel", "ServingEngine", "ServingMetrics",
            "BatchingConfig", "DynamicBatcher", "ServingFuture",
            "QueueFullError", "ServingStopped", "CircuitBreaker",
-           "CircuitOpenError", "HealthMonitor"]
+           "CircuitOpenError", "HealthMonitor", "ModelHost", "SwapError",
+           "AdmissionConfig", "AdmissionController",
+           "ServiceOverloadedError"]
 
 
 def load(dirname, model_filename=None, params_filename=None):

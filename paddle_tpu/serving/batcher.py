@@ -316,6 +316,16 @@ class DynamicBatcher:
     def pending_rows(self) -> int:
         return self._queued_rows
 
+    @property
+    def oldest_wait_s(self) -> float:
+        """How long the oldest queued request has been waiting (0.0 when
+        the queue is empty) — an admission-control signal: a growing
+        oldest-wait means the workers are not keeping up."""
+        with self._lock:
+            if not self._queue:
+                return 0.0
+            return max(0.0, time.monotonic() - self._queue[0].t_submit)
+
     def _margin_s(self) -> float:
         """Scheduling-jitter allowance: 25% of the request timeout
         budget (>= 1ms). The flush deadline is pulled one margin BEFORE

@@ -26,7 +26,9 @@ from ..observability import trace as obs_trace
 from ..resilience import faults
 from ..resilience import health as health_mod
 from ..resilience.health import CircuitOpenError, HealthMonitor
-from .batcher import Batch, BatchingConfig, DynamicBatcher, ServingFuture
+from .admission import AdmissionConfig, AdmissionController
+from .batcher import (Batch, BatchingConfig, DynamicBatcher,
+                      QueueFullError, ServingFuture)
 from .metrics import ServingMetrics
 
 __all__ = ["ServingEngine"]
@@ -37,7 +39,8 @@ class ServingEngine:
                  metrics: Optional[ServingMetrics] = None,
                  num_workers: int = 1,
                  health: Optional[HealthMonitor] = None,
-                 async_dispatch: bool = False):
+                 async_dispatch: bool = False,
+                 admission: Optional[AdmissionConfig] = None):
         self.model = model
         self.config = config or BatchingConfig()
         self.metrics = metrics or ServingMetrics()
@@ -47,6 +50,13 @@ class ServingEngine:
         self.health = health or HealthMonitor()
         self.batcher = DynamicBatcher(model.feed_specs, self.config,
                                       self.metrics)
+        # optional load shedding in front of the batcher: queue-depth /
+        # rolling-p99 limits reject with a fast ServiceOverloadedError
+        # instead of letting the queue (and every admitted request's
+        # latency) grow without bound — see admission.py
+        self.admission = AdmissionController(
+            admission, self.batcher, self.metrics) \
+            if admission is not None else None
         self.num_workers = int(num_workers)
         # opt-in host/device pipelining BETWEEN bucket flushes: each
         # worker dispatches batch N (Executor.run sync=False), then —
@@ -140,21 +150,29 @@ class ServingEngine:
             raise RuntimeError(
                 "engine not started — call engine.start() first "
                 "(a request submitted now would wait forever)")
+        if self.admission is not None:
+            # sheds raise ServiceOverloadedError and count themselves
+            # into paddle_tpu_serving_shed_total{reason=}
+            self.admission.check()
         admit = self.health.allow_request()
         if not admit:   # already counted in the breaker's shed_total
+            self.metrics.shed("circuit_open")
             raise CircuitOpenError(
-                "serving circuit is open (consecutive batch failures "
-                "tripped the breaker) — request shed; see "
-                "engine.stats()['health']")
+                "serving circuit is open (batch failures tripped the "
+                "breaker) — request shed; see engine.stats()['health']")
         try:
             return self.batcher.submit(feed)
-        except BaseException:
+        except BaseException as e:
             # the admitted request never reached a batch (bad feed,
             # queue full): if it held the half-open probe slot, hand it
             # back instead of wedging the breaker — but only then, so a
             # non-probe failure can't mint a second concurrent probe
             if admit is health_mod.PROBE:
                 self.health.release_probe()
+            if isinstance(e, QueueFullError):
+                # backpressure is a rejection too: the shed ledger must
+                # account for EVERY turned-away request
+                self.metrics.shed("queue_full")
             raise
 
     def predict(self, feed: Dict[str, Any],
@@ -178,6 +196,8 @@ class ServingEngine:
         out["health"] = self.health.snapshot()
         # convenience alias; the breaker's counter is the single source
         out["shed"] = out["health"]["breaker"]["shed_total"]
+        out["admission"] = (self.admission.snapshot()
+                            if self.admission is not None else None)
         return out
 
     # -- worker ------------------------------------------------------------

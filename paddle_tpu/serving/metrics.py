@@ -55,12 +55,18 @@ class ServingMetrics:
         self.registry = reg
         self.engine_label = str(next(_engine_ids))
         lab = {"engine": self.engine_label}
+        # families this instance claimed a series in, for retire()
+        self._owned_families = []
 
         def counter(name, help):
-            return reg.counter(name, help, ("engine",)).labels(**lab)
+            fam = reg.counter(name, help, ("engine",))
+            self._owned_families.append(fam)
+            return fam.labels(**lab)
 
         def histogram(name, help):
-            return reg.histogram(name, help, ("engine",)).labels(**lab)
+            fam = reg.histogram(name, help, ("engine",))
+            self._owned_families.append(fam)
+            return fam.labels(**lab)
 
         self.requests = counter(
             "paddle_tpu_serving_requests_total",
@@ -74,16 +80,28 @@ class ServingMetrics:
         self.errors = counter(
             "paddle_tpu_serving_errors_total",
             "Requests failed by a batch dispatch/delivery error.")
+        # the complete rejection ledger: EVERY request turned away
+        # before reaching a batch lands here exactly once, by reason —
+        # queue_depth / latency_p99 / fault (admission layer),
+        # circuit_open (breaker), queue_full (batcher backpressure)
+        self._shed_family = reg.counter(
+            "paddle_tpu_serving_shed_total",
+            "Requests shed before batching, by reason: queue_depth and "
+            "latency_p99 (admission limits), fault (injected admission "
+            "fault), circuit_open (breaker), queue_full (batcher "
+            "backpressure).", ("engine", "reason"))
         self.batches = counter(
             "paddle_tpu_serving_batches_total",
             "Batches flushed by the dynamic batcher.")
         self.warmup_compiles = counter(
             "paddle_tpu_serving_warmup_compiles_total",
             "Executables compiled during engine warmup.")
-        self.queue_depth = reg.gauge(
+        _depth_fam = reg.gauge(
             "paddle_tpu_serving_queue_depth_rows",
             "Rows waiting in the dynamic batcher queue (sampled on "
-            "every submit/flush).", ("engine",)).labels(**lab)
+            "every submit/flush).", ("engine",))
+        self._owned_families.append(_depth_fam)
+        self.queue_depth = _depth_fam.labels(**lab)
         self.batch_fill_ratio = histogram(
             "paddle_tpu_serving_batch_fill_ratio",
             "Real rows / padded bucket rows per flushed batch "
@@ -106,6 +124,42 @@ class ServingMetrics:
         self._attr_job = f"engine_{self.engine_label}"
         self.mfu = None
         self.model_flops = None
+
+    def shed(self, reason: str) -> None:
+        """Count one shed request under `reason` in the
+        paddle_tpu_serving_shed_total ledger."""
+        self._shed_family.labels(engine=self.engine_label,
+                                 reason=reason).inc()
+
+    def shed_by_reason(self) -> Dict[str, float]:
+        """This engine's shed counts keyed by reason (JSON-able)."""
+        out = {}
+        for key, child in self._shed_family.samples():
+            if key[0] == self.engine_label:
+                out[key[1]] = child.value
+        return out
+
+    def retire(self) -> None:
+        """Drop every registry series this engine claimed. Called by
+        the ModelHost when a version is permanently retired (a
+        rolled-back candidate, or the drained-out old version after a
+        completed swap) — a long-lived host swapping a new checkpoint
+        every few hours must not grow /metrics cardinality and
+        histogram-window memory without bound. The instance's own
+        instrument references keep working (stats() still answers);
+        only the shared scrape forgets the series, the way it forgets
+        a garbage-collected breaker's."""
+        key = (self.engine_label,)
+        for fam in self._owned_families:
+            fam.discard(key)
+        for k, _ in self._shed_family.samples():
+            if k[0] == self.engine_label:
+                self._shed_family.discard(k)
+        if self.mfu is not None:
+            for name in ("paddle_tpu_mfu", "paddle_tpu_model_flops"):
+                fam = self.registry.get(name)
+                if fam is not None:
+                    fam.discard((self._attr_job,))
 
     def set_mfu(self, mfu: float, flops: float) -> None:
         """Engine callback after each completed batch: publish the live
@@ -139,6 +193,7 @@ class ServingMetrics:
             "batch_rows": self.batch_rows.snapshot(),
             "latency_s": self.latency_s.snapshot(),
             "queue_wait_s": self.queue_wait_s.snapshot(),
+            "shed_by_reason": self.shed_by_reason(),
             "mfu": self.mfu.value if self.mfu is not None else 0.0,
             "model_flops": self.model_flops.value
             if self.model_flops is not None else 0.0,

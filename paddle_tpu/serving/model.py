@@ -22,7 +22,10 @@ __all__ = ["ServableModel"]
 class ServableModel:
     def __init__(self, program, feed_names: List[str], fetch_vars,
                  scope: Scope, feed_specs: Dict[str, Dict],
-                 fetch_specs: Dict[str, Dict]):
+                 fetch_specs: Dict[str, Dict],
+                 version: Optional[str] = None,
+                 executor: Optional[Executor] = None,
+                 run_lock: Optional[threading.Lock] = None):
         self.program = program
         self.feed_names = list(feed_names)
         self.fetch_vars = list(fetch_vars)
@@ -31,20 +34,36 @@ class ServableModel:
         self.scope = scope
         self.feed_specs = dict(feed_specs)
         self.fetch_specs = dict(fetch_specs)
-        self.executor = Executor()
+        #: deploy-time identity (save_inference_model model_version
+        #: metadata, or assigned by the ModelHost); None = unversioned
+        self.version = version
+        # `executor`/`run_lock` let a ModelHost precompile a swap
+        # candidate against the SAME compile cache the live version
+        # serves from (the cache key includes program uid+version, so
+        # executables of different model versions coexist); sharing an
+        # executor requires sharing its run lock too — executor
+        # internals are not thread-safe across versions either.
+        if (executor is None) != (run_lock is None):
+            raise ValueError("share executor and run_lock together "
+                             "(executor internals are serialized by "
+                             "the lock)")
+        self.executor = executor if executor is not None else Executor()
         self._engine = None  # set by ServingEngine.start()
         # Executor internals (compile cache + counters, scope step var,
         # deferred flags) are not thread-safe; serialize runs so
         # num_workers > 1 engines stay correct (workers still overlap
         # host-side batch assembly with the device run).
-        self._run_lock = threading.Lock()
+        self._run_lock = run_lock if run_lock is not None \
+            else threading.Lock()
         self._check_frozen()
         self._verify()
 
     # ------------------------------------------------------------------
     @classmethod
     def load(cls, dirname: str, model_filename: Optional[str] = None,
-             params_filename: Optional[str] = None) -> "ServableModel":
+             params_filename: Optional[str] = None,
+             executor: Optional[Executor] = None,
+             run_lock: Optional[threading.Lock] = None) -> "ServableModel":
         """Load a `save_inference_model` directory into a private scope."""
         scope = Scope()
         exe = Executor()
@@ -53,7 +72,9 @@ class ServableModel:
                 dirname, exe, model_filename=model_filename,
                 params_filename=params_filename, return_meta=True)
         return cls(prog, feed_names, fetch_vars, scope,
-                   meta["feed_specs"], meta["fetch_specs"])
+                   meta["feed_specs"], meta["fetch_specs"],
+                   version=meta.get("model_version"),
+                   executor=executor, run_lock=run_lock)
 
     def _check_frozen(self):
         """A servable program must not write persistable state: an
@@ -113,9 +134,10 @@ class ServableModel:
         return self.run_direct(feed)
 
     def serve(self, config=None, metrics=None, num_workers: int = 1,
-              async_dispatch: bool = False):
+              async_dispatch: bool = False, admission=None, health=None):
         """Create (but do not start) a ServingEngine bound to this model."""
         from .engine import ServingEngine
         return ServingEngine(self, config=config, metrics=metrics,
                              num_workers=num_workers,
-                             async_dispatch=async_dispatch)
+                             async_dispatch=async_dispatch,
+                             admission=admission, health=health)

@@ -1,0 +1,543 @@
+"""Serving lifecycle acceptance tests (ISSUE 7): atomic hot-swap with
+canary/rollback (zero dropped or client-visible-failed requests), and
+admission control / load shedding in front of the batcher."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, serving
+from paddle_tpu.observability import flight_recorder as fr
+from paddle_tpu.resilience import FaultInjector
+
+
+def _freeze_mlp(tmp_path, name, seed, version=None, in_dim=8, out_dim=4):
+    main = pt.Program()
+    startup = pt.Program()
+    main.random_seed = startup.random_seed = seed
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [in_dim], dtype="float32")
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=out_dim, act="softmax")
+    exe = pt.Executor()
+    exe.run(startup)
+    dirname = str(tmp_path / name)
+    pt.io.save_inference_model(dirname, ["x"], [pred], exe, main,
+                               model_version=version)
+    return dirname
+
+
+def _small_config(**kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("batch_buckets", [4])
+    kw.setdefault("max_latency_ms", 1.0)
+    return serving.BatchingConfig(**kw)
+
+
+class _Traffic:
+    """Closed-loop background clients; every error is client-visible."""
+
+    def __init__(self, host, feed, clients=2, timeout=60.0):
+        self.host = host
+        self.feed = feed
+        self.timeout = timeout
+        self.errors = []
+        self.ok = 0
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._threads = [threading.Thread(target=self._run, daemon=True)
+                         for _ in range(clients)]
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.host.predict(self.feed, timeout=self.timeout)
+                with self._lock:
+                    self.ok += 1
+            except Exception as e:
+                with self._lock:
+                    self.errors.append(e)
+
+    def __enter__(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=120)
+        return False
+
+
+@pytest.fixture
+def fresh_recorder(tmp_path):
+    """Point the default flight recorder at an empty per-test dir so
+    bundle assertions are exact."""
+    rec = fr.FlightRecorder(dump_dir=str(tmp_path / "flightrec"),
+                            min_interval_s=0.0).enable()
+    prev = fr.set_flight_recorder(rec)
+    yield rec
+    rec.disable()
+    fr.set_flight_recorder(prev)
+
+
+def _reasons(rec):
+    return sorted(b.rsplit("_", 1)[-1] for b in rec.dumps())
+
+
+# ---------------------------------------------------------------------------
+# versioned artifacts
+# ---------------------------------------------------------------------------
+def test_model_version_metadata_roundtrip(tmp_path):
+    d = _freeze_mlp(tmp_path, "m", seed=0, version="ckpt-123")
+    model = serving.load(d)
+    assert model.version == "ckpt-123"
+    # artifacts saved without a version stay loadable (version None)
+    d2 = _freeze_mlp(tmp_path, "m2", seed=0)
+    assert serving.load(d2).version is None
+    # re-freezing WITHOUT a version into a dir that had one must not
+    # inherit the stale __version__ sidecar
+    d3 = _freeze_mlp(tmp_path, "m", seed=0)
+    assert d3 == d
+    assert serving.load(d3).version is None
+
+
+# ---------------------------------------------------------------------------
+# hot swap
+# ---------------------------------------------------------------------------
+def test_hot_swap_under_traffic_zero_failures(tmp_path, fresh_recorder):
+    d1 = _freeze_mlp(tmp_path, "v1", seed=0, version="v1")
+    d2 = _freeze_mlp(tmp_path, "v2", seed=1, version="v2")
+    host = serving.ModelHost(d1, config=_small_config()).start()
+    feed = {"x": np.random.RandomState(0).rand(2, 8).astype(np.float32)}
+    try:
+        with _Traffic(host, feed) as traffic:
+            report = host.swap(d2, canary_fraction=0.5,
+                               canary_min_requests=5,
+                               canary_timeout_s=60.0)
+        assert report["outcome"] == "completed", report
+        assert report["from_version"] == "v1"
+        assert report["to_version"] == "v2"
+        assert report["canary"]["successes"] >= 5
+        assert report["canary"]["failures"] == 0
+        # the whole swap was invisible to clients
+        assert traffic.errors == []
+        assert traffic.ok > 0
+        # post-swap traffic runs on the NEW weights
+        (served,) = host.predict(feed, timeout=60)
+        (direct,) = serving.load(d2).run_direct(feed)
+        np.testing.assert_allclose(served, direct, rtol=1e-5, atol=1e-6)
+        assert host.current_version == "v2"
+    finally:
+        host.stop(timeout=120)
+    # a clean swap writes NO flight-recorder bundle
+    assert fresh_recorder.dumps() == []
+    # metrics: swap outcome + live/retired version series
+    reg = host._registry
+    swaps = dict((k, c.value) for k, c in reg.get(
+        "paddle_tpu_serving_swaps_total").samples())
+    assert swaps[(host.host_label, "completed")] == 1
+    ver = dict((k, g.value) for k, g in reg.get(
+        "paddle_tpu_serving_model_version").samples())
+    assert ver[(host.host_label, "v2")] == 1.0
+    assert ver[(host.host_label, "v1")] == 0.0
+
+
+def test_swap_shares_executor_and_compile_cache(tmp_path):
+    d1 = _freeze_mlp(tmp_path, "v1", seed=0, version="v1")
+    d2 = _freeze_mlp(tmp_path, "v2", seed=1, version="v2")
+    host = serving.ModelHost(d1, config=_small_config()).start()
+    try:
+        exe_before = host._current.model.executor
+        report = host.swap(d2, canary_fraction=0.0,
+                           share_executor=True)
+        assert report["outcome"] == "completed"
+        # the candidate precompiled into the SAME executor compile
+        # cache the old version served from
+        assert host._current.model.executor is exe_before
+        # and the cut is warm: a fresh request compiles nothing
+        misses = exe_before.cache_stats["misses"]
+        host.predict({"x": np.zeros((1, 8), np.float32)}, timeout=60)
+        assert exe_before.cache_stats["misses"] == misses
+    finally:
+        host.stop(timeout=120)
+
+
+def test_swap_drains_old_in_flight_requests(tmp_path):
+    """Requests queued on the old version when the cut happens complete
+    on the old version — a swap drops nothing."""
+    d1 = _freeze_mlp(tmp_path, "v1", seed=0, version="v1")
+    d2 = _freeze_mlp(tmp_path, "v2", seed=1, version="v2")
+    # deadline far away + big bucket: submits sit in the queue until
+    # the swap's drain flushes them
+    host = serving.ModelHost(d1, config=_small_config(
+        max_batch_size=8, batch_buckets=[8],
+        max_latency_ms=60_000.0)).start()
+    rng = np.random.RandomState(3)
+    feeds = [{"x": rng.rand(1, 8).astype(np.float32)} for _ in range(3)]
+    try:
+        futures = [host.submit(f) for f in feeds]
+        assert not any(f.done() for f in futures)
+        report = host.swap(d2, canary_fraction=0.0)
+        assert report["outcome"] == "completed"
+        direct_model = serving.load(d1)  # v1: what they were queued on
+        for fut, feed in zip(futures, feeds):
+            (out,) = fut.result(timeout=0)  # completed by the drain
+            (direct,) = direct_model.run_direct(feed)
+            np.testing.assert_allclose(out, direct, rtol=1e-5,
+                                       atol=1e-6)
+    finally:
+        host.stop(timeout=120)
+
+
+def test_swap_guard_rails(tmp_path):
+    d1 = _freeze_mlp(tmp_path, "v1", seed=0, version="v1")
+    host = serving.ModelHost(d1, config=_small_config())
+    with pytest.raises(RuntimeError, match="not started"):
+        host.submit({"x": np.zeros((1, 8), np.float32)})
+    with pytest.raises(serving.SwapError, match="not serving"):
+        host.swap(d1)
+    host.start()
+    with pytest.raises(ValueError, match="canary_fraction"):
+        host.swap(d1, canary_fraction=1.5)
+    host.stop(timeout=120)
+    with pytest.raises(serving.SwapError, match="not serving"):
+        host.swap(d1)
+
+
+# ---------------------------------------------------------------------------
+# rollback
+# ---------------------------------------------------------------------------
+def test_bad_candidate_rolls_back_with_clients_unharmed(
+        tmp_path, fresh_recorder):
+    """A candidate whose batches fail: canary requests transparently
+    retry on the stable version (zero client-visible failures), the
+    candidate's breaker/error rate trips, and the swap rolls back with
+    the old weights intact."""
+    d1 = _freeze_mlp(tmp_path, "v1", seed=0, version="v1")
+    d2 = _freeze_mlp(tmp_path, "v2", seed=1, version="v2")
+    # warmup=False: the poison below must hit canary batches, not the
+    # precompile phase (which would roll back before canary started)
+    host = serving.ModelHost(d1, config=_small_config(),
+                             warmup=False).start()
+    bad = serving.ServableModel.load(d2)
+
+    def poisoned_run(feed, sync=True):
+        raise RuntimeError("poisoned candidate batch")
+
+    bad.run_direct = poisoned_run
+    feed = {"x": np.ones((1, 8), np.float32)}
+    try:
+        with _Traffic(host, feed) as traffic:
+            report = host.swap(bad, canary_fraction=1.0,
+                               canary_min_requests=6,
+                               canary_max_error_rate=0.25,
+                               canary_timeout_s=60.0)
+        assert report["outcome"] == "rolled_back", report
+        assert report["error"] in ("breaker_tripped",
+                                   "canary_error_rate"), report
+        assert report["canary"]["failures"] > 0
+        # every failed canary request was retried on stable — clients
+        # never saw the poisoned candidate
+        assert traffic.errors == []
+        assert traffic.ok > 0
+        assert host.current_version == "v1"
+        # rolled-back-to weights are intact
+        (served,) = host.predict(feed, timeout=60)
+        (direct,) = serving.load(d1).run_direct(feed)
+        np.testing.assert_allclose(served, direct, rtol=1e-5,
+                                   atol=1e-6)
+    finally:
+        host.stop(timeout=120)
+    assert "rollback" in _reasons(fresh_recorder)
+    reg = host._registry
+    swaps = dict((k, c.value) for k, c in reg.get(
+        "paddle_tpu_serving_swaps_total").samples())
+    assert swaps[(host.host_label, "rolled_back")] == 1
+    canary = dict((k, c.value) for k, c in reg.get(
+        "paddle_tpu_serving_canary_requests_total").samples())
+    assert canary[(host.host_label, "failure")] > 0
+
+
+@pytest.mark.chaos
+def test_chaos_mid_swap_fault_rolls_back_zero_client_failures(
+        tmp_path, fresh_recorder):
+    """The acceptance chaos test: a fault injected into the swap
+    machinery itself (serving.swap) under concurrent traffic triggers
+    rollback; across the WHOLE swap no client request fails and the
+    prior version keeps serving bit-identical results."""
+    d1 = _freeze_mlp(tmp_path, "v1", seed=0, version="v1")
+    d2 = _freeze_mlp(tmp_path, "v2", seed=1, version="v2")
+    host = serving.ModelHost(d1, config=_small_config()).start()
+    feed = {"x": np.random.RandomState(1).rand(1, 8).astype(np.float32)}
+    (before,) = host.predict(feed, timeout=60)
+    try:
+        with _Traffic(host, feed) as traffic:
+            with FaultInjector(seed=7) as fi:
+                # skip the load-phase fire; blow up the post-precompile
+                # one — mid-swap, candidate engine already running
+                fi.on("serving.swap", raises=RuntimeError, times=1,
+                      after=1)
+                report = host.swap(d2, canary_fraction=0.5,
+                                   canary_min_requests=3,
+                                   canary_timeout_s=60.0)
+            assert fi.triggered("serving.swap") == 1
+        assert report["outcome"] == "rolled_back", report
+        assert "injected fault" in report["error"]
+        assert traffic.errors == [], traffic.errors[:3]
+        assert traffic.ok > 0
+        assert host.current_version == "v1"
+        (after,) = host.predict(feed, timeout=60)
+        np.testing.assert_array_equal(before, after)
+    finally:
+        host.stop(timeout=120)
+    assert "rollback" in _reasons(fresh_recorder)
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+def test_admission_sheds_on_queue_depth_and_ledger_accounts(tmp_path):
+    d1 = _freeze_mlp(tmp_path, "v1", seed=0, version="v1")
+    model = serving.load(d1)
+    # nothing flushes (far deadline, big bucket): the queue builds and
+    # admission sheds everything past the depth limit
+    engine = model.serve(
+        _small_config(max_batch_size=64, batch_buckets=[64],
+                      max_latency_ms=60_000.0,
+                      queue_capacity_rows=10_000),
+        admission=serving.AdmissionConfig(max_queue_rows=4,
+                                          shed_storm_threshold=None))
+    engine.start(warmup=False)
+    feed = {"x": np.ones((1, 8), np.float32)}
+    futures, rejected = [], 0
+    try:
+        for _ in range(12):
+            try:
+                futures.append(engine.submit(feed))
+            except serving.ServiceOverloadedError as e:
+                assert e.reason == "queue_depth"
+                rejected += 1
+    finally:
+        engine.stop(drain=True, timeout=120)
+    assert rejected > 0 and len(futures) == 12 - rejected
+    # every admitted request completed (shedding drops only at the
+    # front door, never after acceptance)
+    for fut in futures:
+        fut.result(timeout=0)
+    # the shed ledger accounts for EVERY rejected request
+    shed = engine.metrics.shed_by_reason()
+    assert shed == {"queue_depth": rejected}
+    assert engine.stats()["admission"]["shed_total"] == rejected
+
+
+def test_admission_sheds_on_rolling_p99(tmp_path):
+    d1 = _freeze_mlp(tmp_path, "v1", seed=0, version="v1")
+    model = serving.load(d1)
+    engine = model.serve(
+        _small_config(),
+        admission=serving.AdmissionConfig(max_p99_s=0.5,
+                                          p99_min_samples=16,
+                                          p99_refresh_s=0.0,
+                                          shed_storm_threshold=None))
+    engine.start(warmup=False)
+    feed = {"x": np.ones((1, 8), np.float32)}
+    try:
+        # below min_samples the p99 limit must NOT shed (cold engine)
+        engine.predict(feed, timeout=60)
+        # overload signal: the latency window says p99 is 2s
+        for _ in range(32):
+            engine.metrics.latency_s.record(2.0)
+        with pytest.raises(serving.ServiceOverloadedError) as ei:
+            engine.submit(feed)
+        assert ei.value.reason == "latency_p99"
+        assert "latency_p99" in engine.metrics.shed_by_reason()
+    finally:
+        engine.stop(drain=True, timeout=120)
+
+
+@pytest.mark.chaos
+def test_chaos_admission_fault_sheds_never_hangs(tmp_path):
+    d1 = _freeze_mlp(tmp_path, "v1", seed=0, version="v1")
+    model = serving.load(d1)
+    engine = model.serve(
+        _small_config(),
+        admission=serving.AdmissionConfig(shed_storm_threshold=None))
+    engine.start(warmup=False)
+    feed = {"x": np.ones((1, 8), np.float32)}
+    try:
+        with FaultInjector(seed=3) as fi:
+            fi.on("serving.admission", raises=ConnectionError, times=2)
+            t0 = time.monotonic()
+            for _ in range(2):
+                with pytest.raises(serving.ServiceOverloadedError):
+                    engine.submit(feed)
+            # a fast shed, not a hang/retry loop
+            assert time.monotonic() - t0 < 5.0
+            assert fi.triggered("serving.admission") == 2
+        # the fault cleared: traffic flows again
+        engine.predict(feed, timeout=60)
+        assert engine.metrics.shed_by_reason()["fault"] == 2
+    finally:
+        engine.stop(drain=True, timeout=120)
+
+
+def test_shed_storm_triggers_flight_recorder(tmp_path, fresh_recorder):
+    d1 = _freeze_mlp(tmp_path, "v1", seed=0, version="v1")
+    model = serving.load(d1)
+    engine = model.serve(
+        _small_config(max_batch_size=64, batch_buckets=[64],
+                      max_latency_ms=60_000.0,
+                      queue_capacity_rows=10_000),
+        admission=serving.AdmissionConfig(max_queue_rows=1,
+                                          shed_storm_threshold=3,
+                                          shed_storm_window_s=30.0))
+    engine.start(warmup=False)
+    feed = {"x": np.ones((1, 8), np.float32)}
+    try:
+        shed = 0
+        for _ in range(8):
+            try:
+                engine.submit(feed)
+            except serving.ServiceOverloadedError:
+                shed += 1
+    finally:
+        engine.stop(drain=True, timeout=120)
+    assert shed >= 3
+    assert "storm" in _reasons(fresh_recorder)  # shed_storm bundles
+
+
+def test_retired_version_series_pruned_from_registry(tmp_path):
+    """A long-lived host swapping every few hours must not grow scrape
+    cardinality without bound: a retired version's engine series (and
+    a rolled-back candidate's) leave the registry; the live engine's
+    stay."""
+    from paddle_tpu.observability import default_registry
+
+    d1 = _freeze_mlp(tmp_path, "v1", seed=0, version="v1")
+    d2 = _freeze_mlp(tmp_path, "v2", seed=1, version="v2")
+    host = serving.ModelHost(d1, config=_small_config(),
+                             warmup=False).start()
+    feed = {"x": np.ones((2, 8), np.float32)}
+    host.predict(feed, timeout=60)
+    old_label = host._current.engine.metrics.engine_label
+    reg = default_registry()
+
+    def engine_labels():
+        fam = reg.get("paddle_tpu_serving_requests_total")
+        return {key[0] for key, _ in fam.samples()}
+
+    assert old_label in engine_labels()
+    report = host.swap(d2, canary_fraction=0.0, version="v2")
+    assert report["outcome"] == "completed"
+    live_label = host._current.engine.metrics.engine_label
+    assert old_label not in engine_labels()       # retired: pruned
+    assert live_label in engine_labels()          # live: kept
+    # rollback prunes the candidate's series too
+    with FaultInjector(seed=0) as fi:
+        fi.on("serving.swap", raises=RuntimeError, times=1, after=1)
+        report = host.swap(d1, canary_fraction=0.0, version="v3")
+    assert report["outcome"] == "rolled_back"
+    labels_after = engine_labels()
+    assert live_label in labels_after
+    assert len(labels_after & {old_label}) == 0
+    host.stop(timeout=120)
+
+
+def test_stale_canary_outcome_does_not_pollute_tally(tmp_path):
+    """A straggler client resolving a PREVIOUS swap's fallback future
+    reports its outcome after that canary was disarmed — it must not
+    count toward the next swap's verdict."""
+    d1 = _freeze_mlp(tmp_path, "v1", seed=0, version="v1")
+    host = serving.ModelHost(d1, config=_small_config(),
+                             warmup=False).start()
+    try:
+        assert host._canary is None
+        host._canary_outcome("ghost-version", ok=False)
+        host._canary_outcome("ghost-version", ok=True)
+        assert host._canary_ok == 0 and host._canary_fail == 0
+    finally:
+        host.stop(timeout=120)
+
+
+def test_model_version_sidecar_survives_meta_drop(tmp_path):
+    """The PTIR writer may drop unknown top-level meta keys; the
+    __version__ sidecar still carries the deploy identity."""
+    import json
+    import os
+
+    d = _freeze_mlp(tmp_path, "m", seed=0, version="ckpt-9")
+    assert os.path.exists(os.path.join(d, "__version__"))
+    jp = os.path.join(d, "__model__.json")
+    if not os.path.exists(jp):
+        pytest.skip("native PTIR artifact in use; cannot tamper meta")
+    with open(jp) as f:
+        meta = json.load(f)
+    meta.pop("model_version", None)
+    with open(jp, "w") as f:
+        json.dump(meta, f)
+    assert serving.load(d).version == "ckpt-9"
+
+
+def test_stop_during_swap_rolls_back_and_stops_candidate(tmp_path):
+    """host.stop() racing a swap: the swap sees the flag at its next
+    phase boundary, rolls back, and no engine outlives the host."""
+    d1 = _freeze_mlp(tmp_path, "v1", seed=0, version="v1")
+    d2 = _freeze_mlp(tmp_path, "v2", seed=1, version="v2")
+    host = serving.ModelHost(d1, config=_small_config(),
+                             warmup=False).start()
+    results = {}
+
+    def swapper():
+        # long canary window with zero traffic: the loop spins until
+        # it observes _stopped (or the deadline would judge clean)
+        results["report"] = host.swap(d2, canary_fraction=0.5,
+                                      canary_min_requests=1_000_000,
+                                      canary_timeout_s=60.0)
+
+    t = threading.Thread(target=swapper, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 30.0
+    while host._canary is None and time.monotonic() < deadline:
+        time.sleep(0.005)   # wait for the canary phase to arm
+    assert host._canary is not None, "swap never reached canary"
+    host.stop(timeout=120)
+    t.join(timeout=120)
+    report = results["report"]
+    assert report["outcome"] == "rolled_back", report
+    assert "host_stopped" in report["error"]
+    # the candidate's workers were stopped by the rollback path
+    assert not any(th.name.startswith("serving-worker")
+                   and th.is_alive() for th in threading.enumerate())
+
+
+def test_fallback_future_stable_retry_is_cached():
+    """A failed canary future retries on stable ONCE: repeated
+    result() calls (done()-poll patterns, second consumers) must not
+    submit duplicate inferences."""
+    from paddle_tpu.serving.lifecycle import _FallbackFuture
+
+    class _FailingFut:
+        def result(self, timeout=None):
+            raise RuntimeError("canary failed")
+
+        def done(self):
+            return True
+
+    calls = []
+
+    class _Host:
+        def _canary_outcome(self, version, ok):
+            pass
+
+        def _stable_result(self, feed, timeout, exc):
+            calls.append(1)
+            return "stable-answer"
+
+    f = _FallbackFuture(_Host(), "vX", {"x": 1}, _FailingFut())
+    assert f.result(timeout=5) == "stable-answer"
+    assert f.result(timeout=5) == "stable-answer"
+    assert len(calls) == 1

@@ -31,7 +31,7 @@ def _smoke_train_and_serve(tmp_path):
 
     trainer.train(num_passes=1, reader=reader)
     pt.io.save_inference_model(str(tmp_path), ["x"], [pred], trainer.exe,
-                               main_program=main)
+                               main_program=main, model_version="v1")
     model = serving.load(str(tmp_path))
     engine = model.serve(serving.BatchingConfig(max_batch_size=2,
                                                 max_latency_ms=1.0))
@@ -40,10 +40,27 @@ def _smoke_train_and_serve(tmp_path):
         engine.predict({"x": np.zeros((1, 4), np.float32)}, timeout=30)
     finally:
         engine.stop()
+    # ISSUE 7 lifecycle families: a hot-swap through a ModelHost (with
+    # admission control attached) populates swap/version/canary/shed
+    host = serving.ModelHost(
+        str(tmp_path),
+        config=serving.BatchingConfig(max_batch_size=2,
+                                      batch_buckets=[2],
+                                      max_latency_ms=1.0),
+        admission=serving.AdmissionConfig(max_queue_rows=64),
+        warmup=False).start()
+    try:
+        host.predict({"x": np.zeros((1, 4), np.float32)}, timeout=30)
+        report = host.swap(str(tmp_path), canary_fraction=0.0,
+                           version="v2")
+        assert report["outcome"] == "completed"
+    finally:
+        host.stop(timeout=120)
+    return host.host_label
 
 
 def test_registry_names_and_help_after_smoke_run(tmp_path):
-    _smoke_train_and_serve(tmp_path)
+    host_label = _smoke_train_and_serve(tmp_path)
     reg = default_registry()
     # families() runs the collectors, so pull-model producers (retry
     # counters, breaker state) materialize their families too
@@ -58,8 +75,25 @@ def test_registry_names_and_help_after_smoke_run(tmp_path):
                      # ISSUE 6: always-on attribution families
                      "paddle_tpu_mfu",
                      "paddle_tpu_model_flops",
-                     "paddle_tpu_step_phase_seconds"):
+                     "paddle_tpu_step_phase_seconds",
+                     # ISSUE 7: serving lifecycle families
+                     "paddle_tpu_serving_swaps_total",
+                     "paddle_tpu_serving_shed_total",
+                     "paddle_tpu_serving_model_version",
+                     "paddle_tpu_serving_canary_requests_total"):
         assert expected in names, f"smoke run did not publish {expected}"
+    # the hot-swap left exactly one live version series (v2=1, v1=0)
+    # for THIS host — other tests' hosts share the global registry, so
+    # scope by the host label instead of asserting across the process
+    ver = {key: g.value for key, g in
+           reg.get("paddle_tpu_serving_model_version").samples()
+           if key[0] == host_label}
+    assert sum(v == 1.0 for v in ver.values()) == 1, ver
+    assert ver.get((host_label, "v2")) == 1.0, ver
+    swaps = {key: c.value for key, c in
+             reg.get("paddle_tpu_serving_swaps_total").samples()}
+    assert any(key[1] == "completed" and v >= 1
+               for key, v in swaps.items()), swaps
     # the attribution families carry both producers: the trainer's
     # job="train" series and the engine's job="engine_<n>" series
     mfu_jobs = {key[0] for key, _ in reg.get("paddle_tpu_mfu").samples()}

@@ -329,6 +329,94 @@ def test_breaker_released_and_lost_probes_do_not_wedge_half_open():
     assert b.state == "closed"
 
 
+def test_breaker_error_rate_mode_trips_on_trickle():
+    """The KNOWN_GAPS trickle-poison closure: one failure in three
+    never builds a consecutive streak (threshold 5 unreachable), but
+    the windowed error RATE trips the circuit."""
+    now = [0.0]
+    b = CircuitBreaker(failure_threshold=5, reset_timeout_s=10.0,
+                       error_rate_threshold=0.3, error_rate_window=12,
+                       error_rate_min_samples=6,
+                       clock=lambda: now[0])
+    # S S F pattern: 33% error rate, max streak 1
+    for i in range(12):
+        if i % 3 == 2:
+            b.record_failure()
+        else:
+            b.record_success()
+        if b.state == "open":
+            break
+    assert b.state == "open"
+    assert b.snapshot()["consecutive_failures"] < 5  # rate, not streak
+    assert not b.allow_request()
+
+
+def test_breaker_error_rate_min_samples_floor():
+    b = CircuitBreaker(failure_threshold=100,
+                       error_rate_threshold=0.5, error_rate_window=32,
+                       error_rate_min_samples=8)
+    # 100% error rate but below the sample floor: must NOT trip
+    for _ in range(7):
+        b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()  # 8th sample crosses the floor at 100% rate
+    assert b.state == "open"
+
+
+def test_breaker_error_rate_half_open_interaction():
+    """Opening clears the window: a successful half-open probe closes
+    the circuit and stale pre-trip failures cannot instantly re-trip
+    it; a fresh trickle after recovery trips it again."""
+    now = [0.0]
+    b = CircuitBreaker(failure_threshold=100, reset_timeout_s=10.0,
+                       error_rate_threshold=0.5, error_rate_window=8,
+                       error_rate_min_samples=4,
+                       clock=lambda: now[0])
+    for _ in range(4):
+        b.record_failure()
+    assert b.state == "open" and b.opened_total == 1
+    assert b.snapshot()["window_samples"] == 0  # cleared on trip
+    now[0] += 10.0
+    assert b.state == "half_open"
+    assert b.allow_request()
+    b.record_success()                  # probe succeeds -> closed
+    assert b.state == "closed"
+    # one failure among fresh successes: rate 1/4 below threshold
+    b.record_success()
+    b.record_success()
+    b.record_failure()
+    assert b.state == "closed"
+    # a fresh 50%+ trickle re-trips (window has 4+ samples again)
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "open" and b.opened_total == 2
+    # a failed probe still re-opens immediately (consecutive path)
+    now[0] += 10.0
+    assert b.state == "half_open"
+    assert b.allow_request()
+    b.record_failure()
+    assert b.state == "open" and b.opened_total == 3
+
+
+def test_breaker_error_rate_param_validation():
+    with pytest.raises(ValueError, match="error_rate_threshold"):
+        CircuitBreaker(error_rate_threshold=1.5)
+    with pytest.raises(ValueError, match="error_rate_threshold"):
+        CircuitBreaker(error_rate_threshold=0.0)
+    with pytest.raises(ValueError, match="error_rate_min_samples"):
+        CircuitBreaker(error_rate_threshold=0.5,
+                       error_rate_min_samples=0)
+    # a window below the min-samples floor could never accumulate
+    # enough outcomes to trip: refuse, don't silently disarm
+    with pytest.raises(ValueError, match="error_rate_window"):
+        CircuitBreaker(error_rate_threshold=0.5, error_rate_window=8,
+                       error_rate_min_samples=16)
+    with pytest.raises(ValueError, match="error_rate_window"):
+        CircuitBreaker(error_rate_threshold=0.5, error_rate_window=0)
+    # rate mode OFF: window/min_samples interplay is irrelevant
+    CircuitBreaker(error_rate_window=8, error_rate_min_samples=16)
+
+
 def test_retryable_accepts_bare_exception_class():
     p = RetryPolicy(max_attempts=3, base_delay_s=0.0,
                     sleep=lambda s: None, retryable=ConnectionError)
@@ -637,3 +725,28 @@ def test_checkpoint_write_retry_rides_injected_failures(tmp_path):
         assert fi.triggered("checkpoint.write") == 2
     found = latest_checkpoint(d)
     assert found is not None and found[1]["step"] == 1
+
+
+def test_breaker_open_stragglers_do_not_poison_window():
+    """Outcomes from batches dispatched BEFORE the trip keep resolving
+    while the circuit is open; they are not evidence and must not fill
+    the freshly-cleared window — or the first ordinary failure after a
+    successful probe would re-trip over ~100% stale history."""
+    now = [0.0]
+    b = CircuitBreaker(failure_threshold=100, reset_timeout_s=10.0,
+                       error_rate_threshold=0.5, error_rate_window=8,
+                       error_rate_min_samples=4,
+                       clock=lambda: now[0])
+    for _ in range(4):
+        b.record_failure()
+    assert b.state == "open"
+    for _ in range(6):          # in-flight stragglers resolve as
+        b.record_failure()      # failures while the circuit is open
+    b.record_success()          # ...and one as a late success
+    assert b.snapshot()["window_samples"] == 0  # all ignored
+    now[0] += 10.0
+    assert b.allow_request()    # the half-open probe
+    b.record_success()          # probe succeeds: circuit closes
+    assert b.state == "closed"
+    b.record_failure()          # first ordinary failure after recovery
+    assert b.state == "closed"  # one failure in a fresh window: no trip
