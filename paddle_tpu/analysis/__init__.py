@@ -18,6 +18,9 @@ from .verifier import (ProgramVerifier, clear_gate_cache,  # noqa
                        executor_gate, verify_enabled, verify_program)
 from .cost_model import (CostModelPass, OpCost, ProgramCost,  # noqa
                          program_cost)
+from .rewrite import (RewritePass, RewriteResult,  # noqa
+                      REWRITE_PASS_REGISTRY, default_rewrite_passes,
+                      optimize_enabled, rewrite_program)
 
 __all__ = [
     "Diagnostic", "Severity", "VerificationError", "VerifyReport",
@@ -25,4 +28,6 @@ __all__ = [
     "register_pass", "ProgramVerifier", "verify_program",
     "verify_enabled", "executor_gate", "clear_gate_cache",
     "CostModelPass", "OpCost", "ProgramCost", "program_cost",
+    "RewritePass", "RewriteResult", "REWRITE_PASS_REGISTRY",
+    "default_rewrite_passes", "optimize_enabled", "rewrite_program",
 ]

@@ -282,6 +282,50 @@ def _flops_for(op: ir.OpDesc,
         return (None, False, None) if x is None else \
             (8 * x.numel, False, None)
 
+    if t == "scaled_dot_product_attention":
+        # the outlined attention mega-op (analysis/rewrite.py): two
+        # seq^2 contractions plus the online softmax. Without this rule
+        # the generic 1-flop/elem fallback would book ~Sq*d instead of
+        # ~4*Sq*Sk*d and silently crater reported MFU post-rewrite.
+        q, k = first("Q"), first("K")
+        if q is None or k is None or len(q.shape) < 3:
+            return None, False, None
+        lead = _prod(q.shape[:-2])
+        sq, d = q.shape[-2], q.shape[-1]
+        sk = k.shape[-2]
+        return (4 * lead * sq * sk * d + 5 * lead * sq * sk,
+                True, None)
+
+    if t in ("lstm", "gru"):
+        # the fused recurrence mega-ops (ops/sequence_ops.py, Pallas
+        # fused_lstm/fused_gru): the per-step recurrent matmul
+        # [n,h]x[h,Gh] over all timesteps dominates; +12 flop/elem
+        # covers the gate nonlinearities. The leading dims product is
+        # n*t for a padded [n, t, Gh] input and the declared row count
+        # for a ragged 2-D declaration (the padded time extent is not
+        # statically known — same documented approximation as the
+        # generic -1 binding).
+        x, w = first("Input"), first("Weight")
+        if x is None or w is None or len(x.shape) < 2 \
+                or len(w.shape) != 2:
+            return None, False, None
+        nt = _prod(x.shape[:-1])
+        h = w.shape[0]
+        gates = 4 if t == "lstm" else 3
+        return 2 * nt * h * gates * h + 12 * nt * h, True, None
+
+    if t == "se_block":
+        # outlined squeeze-excitation gate (ops/fusion_ops.py): global
+        # pool + gate multiply sweep the activation twice; the two
+        # bottleneck FCs are 2*MAC each
+        x, w1 = first("X"), first("W1")
+        if x is None or w1 is None or len(x.shape) != 4 \
+                or len(w1.shape) != 2:
+            return None, False, None
+        n, c = x.shape[0], x.shape[1]
+        r = w1.shape[1]
+        return 2 * x.numel + 4 * n * c * r, True, None
+
     if t in _OPTIMIZER_FLOPS:
         p = first("Param")
         if p is None:

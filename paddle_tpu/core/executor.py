@@ -370,6 +370,10 @@ class CompiledProgram:
         # Executor.run at the compile-cache miss that built this
         # executable (None when the cost model could not run)
         self.cost = None
+        # RewriteResult of the optimizer pipeline that produced the
+        # program this executable traced (None: rewrite disabled,
+        # failed, or changed nothing)
+        self.rewrite = None
 
 
 class _BlockPrefix:
@@ -875,15 +879,44 @@ class Executor:
                 "iterations": iterations,
                 "or_reduce_tail": len(exhausted),
                 "stacked_feed": stacked_feed}
-            compiled = self._compile(program, block, feed_sig, fetch_names,
-                                     scope, while_bounds=while_bounds,
+            # Rewrite pipeline (analysis/rewrite.py): DCE/CSE/constant
+            # folding + fusion outlining onto the Pallas kernels, run
+            # once per compile-cache miss on a CLONE (the caller's
+            # program object is never mutated). Every pass is verified
+            # by fast_passes() post-rewrite; a failed verification
+            # discards that pass, and any unexpected error falls back
+            # to compiling the program exactly as built.
+            exec_program, exec_block = program, block
+            rewrite_result = None
+            from ..analysis import rewrite as _rewrite
+            if _rewrite.optimize_enabled():
+                try:
+                    rewrite_result = _rewrite.rewrite_program(
+                        program, block_idx, feed_names=feed.keys(),
+                        fetch_names=fetch_names,
+                        donate=self.donate_state,
+                        async_dispatch=not sync,
+                        label=f"program uid={program.uid} "
+                              f"block={block_idx}")
+                except Exception:
+                    rewrite_result = None
+                if rewrite_result is not None and rewrite_result.changed:
+                    exec_program = rewrite_result.program
+                    exec_block = exec_program.block(block_idx)
+            compiled = self._compile(exec_program, exec_block, feed_sig,
+                                     fetch_names, scope,
+                                     while_bounds=while_bounds,
                                      donate=self.donate_state, **kw)
+            # introspection: which rewrite produced this executable
+            compiled.rewrite = rewrite_result
             # static cost attribution, attached once per compiled
             # executable: per-op FLOPs/bytes with the dynamic batch dim
             # bound from THIS dispatch's feed shapes (stacked feeds
             # strip the leading K axis — the cost is per traced
             # iteration, matching the per-batch step_seconds the
-            # trainer divides by). Best-effort: the cost model must
+            # trainer divides by). Computed on the REWRITTEN program —
+            # the graph that actually runs — so MFU attribution stays
+            # correct post-rewrite. Best-effort: the cost model must
             # never fail a compile.
             try:
                 from ..analysis import cost_model as _cost_model
@@ -893,7 +926,7 @@ class Executor:
                     if isinstance(shp, tuple):
                         fs[fk] = shp[1:] if stacked_feed else shp
                 compiled.cost = _cost_model.program_cost(
-                    program, block_idx, feed_shapes=fs)
+                    exec_program, block_idx, feed_shapes=fs)
             except Exception:
                 compiled.cost = None
             self._cache[key] = compiled

@@ -121,6 +121,21 @@ FLAGS: Dict[str, tuple] = {
         "directory flight-recorder dump bundles are written to "
         "(flightrec_<ms>_<pid>_<seq>_<reason>/, pruned to this "
         "process's newest 8)"),
+    "PADDLE_TPU_OPTIMIZE": (
+        "1", "analysis/rewrite.py (gate in core/executor.py)",
+        "ProgramDesc rewrite pipeline on every compile-cache miss: "
+        "dead-op elimination, CSE, constant folding, fusion outlining "
+        "onto the Pallas kernels, and kernel-dispatch annotation — "
+        "each pass verified by fast_passes() and discarded on failure; "
+        "0 compiles every program exactly as built"),
+    "PADDLE_TPU_PALLAS_SDPA": (
+        "1", "analysis/rewrite.py (kernel_dispatch pass)",
+        "flash-kernel dispatch annotation for "
+        "scaled_dot_product_attention ops during rewrite: '1' leaves "
+        "the op's measured min-seq auto policy in charge "
+        "(PADDLE_TPU_FLASH_MIN_SEQ), 'force' stamps use_flash=True "
+        "(interpret mode off-TPU — test coverage), '0' pins the naive "
+        "composition"),
     "PADDLE_TPU_BN_CUSTOM_VJP": (
         "0", "ops/nn_ops.py",
         "use the round-2 hand-written BatchNorm backward (custom_vjp) "

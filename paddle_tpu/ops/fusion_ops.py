@@ -1,0 +1,44 @@
+"""Fused mega-ops produced by the rewrite layer's subgraph outlining
+(analysis/rewrite.py).
+
+These ops exist so a matched multi-op subgraph becomes ONE op in the
+IR: one row in the cost model, one unit for the verifier, and one
+dispatch point for a hand kernel. Gradients come from the generic
+``__vjp__`` grad op (core/backward.py) — every compute rule here is
+differentiable JAX, so the outlined backward is exact.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .math_ops import _mxu_matmul
+
+
+@register_op("se_block")
+def _se_block(ctx):
+    """Squeeze-excitation channel gate as one op: global average pool
+    -> bottleneck FC (relu) -> expand FC (sigmoid) -> per-channel gate.
+
+    X: [n, c, h, w]; W1: [c, r]; B1: [r]; W2: [r, c]; B2: [c].
+    Mirrors the composed layer chain (models/resnet.py
+    squeeze_excitation) the rewrite layer outlines into this op; the
+    pooled reduction accumulates in f32 exactly like pool2d's avg path
+    so bf16 activations lose no mantissa.
+    """
+    x = ctx.input("X")
+    w1, b1 = ctx.input("W1"), ctx.input("B1")
+    w2, b2 = ctx.input("W2"), ctx.input("B2")
+    xf = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+    pooled = (jnp.sum(xf, axis=(2, 3)) /
+              (x.shape[2] * x.shape[3])).astype(x.dtype)  # [n, c]
+    h1 = _mxu_matmul(pooled, w1)
+    if b1 is not None:
+        h1 = h1 + b1.reshape(1, -1)
+    h1 = jax.nn.relu(h1)
+    g = _mxu_matmul(h1, w2)
+    if b2 is not None:
+        g = g + b2.reshape(1, -1)
+    g = jax.nn.sigmoid(g)
+    ctx.set_output("Out", x * g[:, :, None, None])

@@ -670,7 +670,17 @@ def _sdpa(ctx):
             head_axis=ctx.attr("head_axis", "model")))
         return
 
+    # Explicit softmax scale (attr "scale"): stamped by the rewrite
+    # layer when it outlines a composed attention chain, preserving the
+    # user's exact scaling; None keeps the standard 1/sqrt(d_key).
+    sm_scale = ctx.attr("scale", None)
+    sm_scale = None if sm_scale is None else float(sm_scale)
+
     use_flash = ctx.attr("use_flash", None)
+    if use_flash and q.ndim != 4:
+        # the flash kernel's layout is [B, H, S, D]; an outlined 3-D
+        # attention keeps the (identical-math) naive composition
+        use_flash = False
     if use_flash is None:
         # measured crossover on v5e (bf16, h8 d64, fwd+bwd, marginal
         # protocol): naive/XLA wins 1.56x at S=256, parity at S=512,
@@ -684,9 +694,12 @@ def _sdpa(ctx):
                      and k.shape[2] >= min_seq)
     if use_flash:
         from .pallas import flash_attention
-        ctx.set_output("Out", flash_attention(q, k, v, mask, causal=causal))
+        ctx.set_output("Out", flash_attention(q, k, v, mask,
+                                              causal=causal,
+                                              sm_scale=sm_scale))
         return
-    scale = 1.0 / np.sqrt(q.shape[-1])
+    scale = sm_scale if sm_scale is not None \
+        else 1.0 / np.sqrt(q.shape[-1])
     scores = jnp.einsum("...qd,...kd->...qk", q, k) * scale
     if mask is not None:
         scores = scores + mask

@@ -17,12 +17,17 @@ def interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def pallas_dispatch(knob_env: str, default: str):
-    """Shared env-knob policy for op-level kernel dispatch:
-    returns (enabled, interpret). "1" enables on TPU only, "force"
-    enables anywhere via interpret mode (test coverage), "0" disables.
+def pallas_dispatch(knob_env: str, default: str, attr=None):
+    """Shared policy for op-level kernel dispatch: returns
+    (enabled, interpret). "1" enables on TPU only, "force" enables
+    anywhere via interpret mode (test coverage), "0" disables.
+
+    ``attr`` is a program-level override stamped onto the op by the
+    rewrite layer's kernel_dispatch pass (analysis/rewrite.py): when
+    present it replaces the env read, making the dispatch decision part
+    of the IR instead of trace-time environment sniffing.
     """
-    knob = os.environ.get(knob_env, default)
+    knob = attr if attr is not None else os.environ.get(knob_env, default)
     if knob == "force":
         return True, None          # None -> interpret_default() inside
     return (knob == "1" and jax.default_backend() == "tpu"), False

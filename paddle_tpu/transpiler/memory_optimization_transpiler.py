@@ -19,6 +19,15 @@ therefore:
 
 release_memory() is the reference's lighter sibling: annotation only, no
 reordering (here they share the implementation).
+
+Successor note (ISSUE 8): `paddle_tpu.analysis.rewrite` is this
+transpiler's successor — a verified rewrite pipeline on the analysis
+pass framework (DCE/CSE/constant folding/fusion outlining) that runs
+automatically on every executor compile-cache miss instead of as a
+user-invoked program mutation, with every pass gated by the static
+verifier. This module stays for the `__dead_vars__` trace-time
+annotation (which the rewrite layer respects and scrubs where its
+renames would invalidate them) and for reference API parity.
 """
 from __future__ import annotations
 

@@ -19,6 +19,10 @@ def _smoke_train_and_serve(tmp_path):
         x = layers.data("x", [4])
         label = layers.data("label", [1])
         pred = layers.fc(x, size=2)
+        # dead op: guarantees the rewrite pipeline (ISSUE 8) records a
+        # dce action on this smoke program, so the rewrite families
+        # below are populated
+        layers.scale(x, 2.0)
         loss = layers.mean(layers.square(pred - label))
         pt.optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
     trainer = Trainer(loss, main_program=main, startup_program=startup)
@@ -80,8 +84,16 @@ def test_registry_names_and_help_after_smoke_run(tmp_path):
                      "paddle_tpu_serving_swaps_total",
                      "paddle_tpu_serving_shed_total",
                      "paddle_tpu_serving_model_version",
-                     "paddle_tpu_serving_canary_requests_total"):
+                     "paddle_tpu_serving_canary_requests_total",
+                     # ISSUE 8: rewrite-pipeline families
+                     "paddle_tpu_rewrite_seconds",
+                     "paddle_tpu_rewrite_ops_total"):
         assert expected in names, f"smoke run did not publish {expected}"
+    # the smoke program carries a deliberately-dead op: the rewrite
+    # ledger must book its removal under {pass="dce", action="remove_op"}
+    rw = {key for key, _ in
+          reg.get("paddle_tpu_rewrite_ops_total").samples()}
+    assert ("dce", "remove_op") in rw, rw
     # the hot-swap left exactly one live version series (v2=1, v1=0)
     # for THIS host — other tests' hosts share the global registry, so
     # scope by the host label instead of asserting across the process
