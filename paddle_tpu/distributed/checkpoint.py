@@ -88,12 +88,22 @@ def save_checkpoint(dirname: str, step: int, main_program=None,
             shutil.rmtree(tmp)
         os.makedirs(tmp)
         payload = pt_io.save_persistables(executor, tmp, program)
+        # the scope step counter is not a program persistable, but it
+        # seeds per-step op randomness (dropout, augmentation) and LR
+        # schedules — without it a resumed run replays the remaining
+        # batches under DIFFERENT randomness than the uninterrupted
+        # run (the sync barrier above already ran, so the value is
+        # settled)
+        from ..core.executor import STEP_VAR
+        step_var = global_scope().find(STEP_VAR)
         meta = {
             "step": int(step),
             "time": time.time(),
             "md5": _md5(payload),
             "payload": os.path.basename(payload),
         }
+        if step_var is not None:
+            meta["step_var"] = int(np.asarray(step_var))
         meta.update(extra_meta or {})
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(meta, f)
@@ -178,4 +188,12 @@ def load_checkpoint(dirname: str, main_program=None, executor=None,
     path, meta = found
     policy.call(pt_io.load_persistables, executor, path, program,
                 name="checkpoint.restore")
+    if meta.get("step_var") is not None:
+        # restore the scope step counter saved beside the weights, so
+        # per-step op randomness and LR schedules continue exactly
+        # where the checkpointed run left off
+        import jax.numpy as jnp
+        from ..core.executor import STEP_VAR
+        global_scope().set(STEP_VAR,
+                           jnp.asarray(int(meta["step_var"]), jnp.int32))
     return meta

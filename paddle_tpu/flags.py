@@ -136,6 +136,43 @@ FLAGS: Dict[str, tuple] = {
         "(PADDLE_TPU_FLASH_MIN_SEQ), 'force' stamps use_flash=True "
         "(interpret mode off-TPU — test coverage), '0' pins the naive "
         "composition"),
+    "PADDLE_TPU_INPUT_WORKERS": (
+        "2", "reader/streaming.py",
+        "initial worker-process count of a StreamingInputService "
+        "(capped at the shard count; elastic scaling moves it between "
+        "MIN and MAX at runtime)"),
+    "PADDLE_TPU_INPUT_MIN_WORKERS": (
+        "1", "reader/streaming.py",
+        "elastic-scaling floor for the streaming input worker pool"),
+    "PADDLE_TPU_INPUT_MAX_WORKERS": (
+        "4", "reader/streaming.py",
+        "elastic-scaling ceiling for the streaming input worker pool "
+        "(also capped at the shard count — a shard is the unit of "
+        "parallelism)"),
+    "PADDLE_TPU_INPUT_SLOTS": (
+        "4", "reader/streaming.py",
+        "shared-memory ring slots per streaming input worker; bounds "
+        "each worker's produced-but-undelivered batches (backpressure) "
+        "and so the service's reorder-buffer memory"),
+    "PADDLE_TPU_INPUT_SCALE_INTERVAL_S": (
+        "2.0", "reader/streaming.py",
+        "elastic-scaling evaluation window: starvation above "
+        "PADDLE_TPU_INPUT_SCALE_UP_STARVED spawns a worker, a full "
+        "queue with zero starvation retires one; 0 disables scaling"),
+    "PADDLE_TPU_INPUT_SCALE_UP_STARVED": (
+        "0.25", "reader/streaming.py",
+        "fraction of deliveries in a scaling window that found the "
+        "prefetch queue dry above which the pool scales up"),
+    "PADDLE_TPU_INPUT_START_METHOD": (
+        "spawn", "reader/streaming.py",
+        "multiprocessing start method for streaming input workers "
+        "('spawn' default — fork duplicates live JAX runtime threads; "
+        "chaos tests use 'fork' so workers inherit the armed "
+        "FaultInjector)"),
+    "PADDLE_TPU_INPUT_MAX_RESPAWNS": (
+        "3", "reader/streaming.py",
+        "total worker respawns a StreamingInputService attempts across "
+        "its lifetime before surfacing the crash to the consumer"),
     "PADDLE_TPU_BN_CUSTOM_VJP": (
         "0", "ops/nn_ops.py",
         "use the round-2 hand-written BatchNorm backward (custom_vjp) "

@@ -41,7 +41,8 @@ __all__ = [
     "ctc_greedy_decoder", "chunk_eval", "autoincreased_step_counter",
     "lod_reset", "prelu", "label_smooth", "rank_loss", "roi_pool",
     "bilinear_interp", "nearest_interp", "resize_bilinear", "upsample",
-    "sampling_id",
+    "sampling_id", "random_crop", "random_flip", "image_normalize",
+    "augment_image",
 ]
 
 
@@ -356,6 +357,64 @@ def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
                             "seed": seed or helper.main_program.desc.next_seed(),
                             "dropout_implementation": dropout_implementation})
     return out
+
+
+def random_crop(x, shape, pad=0, seed=None, name=None):
+    """Per-sample random spatial crop of an NCHW batch to
+    ``shape=[h, w]`` after zero-padding ``pad`` on each spatial edge
+    (ops/augment_ops.py — runs on device where XLA fuses it into the
+    step). Deterministic under the program seed."""
+    helper = LayerHelper("random_crop", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="random_crop", inputs={"X": x},
+                     outputs={"Out": out},
+                     attrs={"shape": list(shape), "pad": int(pad),
+                            "seed":
+                                seed or helper.main_program.desc.next_seed()})
+    return out
+
+
+def random_flip(x, prob=0.5, seed=None, name=None):
+    """Per-sample horizontal flip (last axis) with probability `prob`
+    (ops/augment_ops.py)."""
+    helper = LayerHelper("random_flip", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="random_flip", inputs={"X": x},
+                     outputs={"Out": out},
+                     attrs={"prob": float(prob),
+                            "seed":
+                                seed or helper.main_program.desc.next_seed()})
+    return out
+
+
+def image_normalize(x, mean, std, scale=1.0, dtype="float32", name=None):
+    """Per-channel ``(x * scale - mean) / std`` for NCHW batches,
+    emitting `dtype` ("bfloat16" = the TPU training path). Feed the
+    reader's raw uint8 batch straight in: the float conversion happens
+    on device (ops/augment_ops.py), not on the input-pipeline host."""
+    helper = LayerHelper("image_normalize", name=name)
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op(type="image_normalize", inputs={"X": x},
+                     outputs={"Out": out},
+                     attrs={"mean": [float(m) for m in mean],
+                            "std": [float(s) for s in std],
+                            "scale": float(scale), "dtype": dtype})
+    return out
+
+
+def augment_image(x, crop_shape=None, pad=0, flip_prob=0.5,
+                  mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225),
+                  scale=1.0 / 255.0, dtype="float32", is_test=False):
+    """The standard train-time image augmentation chain as device ops:
+    [random_crop] -> random_flip -> image_normalize. With is_test=True
+    the random stages are skipped (center behaviour: no crop offset
+    support — pass crop_shape=None and pre-sized eval batches)."""
+    if not is_test:
+        if crop_shape is not None:
+            x = random_crop(x, crop_shape, pad=pad)
+        if flip_prob > 0:
+            x = random_flip(x, prob=flip_prob)
+    return image_normalize(x, mean, std, scale=scale, dtype=dtype)
 
 
 def cross_entropy(input, label, soft_label=False):

@@ -24,3 +24,4 @@ def all_ops():
 from . import csp_ops  # noqa: F401
 from . import reader_ops  # noqa: F401
 from . import fusion_ops  # noqa: F401
+from . import augment_ops  # noqa: F401

@@ -1,0 +1,85 @@
+"""Device-side input augmentation ops.
+
+Image augmentation as PROGRAM ops, so XLA fuses random crop / flip /
+normalize into the forward step itself (*Operator Fusion in XLA*,
+PAPERS.md): the streaming input plane (reader/streaming.py) ships raw
+uint8 batches straight from decode, and the float conversion +
+augmentation math that used to burn reader-host CPU runs on the
+accelerator — in bf16 if requested — where it fuses with the first
+conv's input handling instead of occupying the input pipeline.
+
+All three ops are deterministic under the program seed (each layer call
+stamps a `seed` attr via next_seed(), folded with the step counter by
+`_op_key`), so seeded training stays bit-reproducible. Inputs are data,
+not parameters: X carries no gradient.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .core_ops import _op_key, jnp_dtype
+
+
+@register_op("random_crop", no_grad_slots=["X"])
+def _random_crop(ctx):
+    """Per-sample random spatial crop of an NCHW batch to attr
+    `shape` = [crop_h, crop_w], after optional zero `pad` on each
+    spatial edge (the pad-then-crop recipe of ResNet training). Output
+    shape is static — [N, C, crop_h, crop_w] — so the executable's
+    signature does not depend on the random offsets."""
+    x = ctx.input("X")
+    if x.ndim != 4:
+        raise ValueError(
+            f"random_crop expects an NCHW batch, got rank {x.ndim}")
+    crop_h, crop_w = ctx.attr("shape")
+    pad = int(ctx.attr("pad", 0))
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    n, c, h, w = x.shape
+    if crop_h > h or crop_w > w:
+        raise ValueError(
+            f"crop {crop_h}x{crop_w} larger than (padded) input "
+            f"{h}x{w}")
+    kh, kw = jax.random.split(_op_key(ctx))
+    oy = jax.random.randint(kh, (n,), 0, h - crop_h + 1)
+    ox = jax.random.randint(kw, (n,), 0, w - crop_w + 1)
+
+    def crop_one(img, y0, x0):
+        return jax.lax.dynamic_slice(img, (0, y0, x0),
+                                     (c, crop_h, crop_w))
+
+    ctx.set_output("Out", jax.vmap(crop_one)(x, oy, ox))
+
+
+@register_op("random_flip", no_grad_slots=["X"])
+def _random_flip(ctx):
+    """Per-sample horizontal flip (last axis) with probability attr
+    `prob` (default 0.5). prob=0 is the identity, prob=1 flips every
+    sample — both still trace the same fused program."""
+    x = ctx.input("X")
+    prob = float(ctx.attr("prob", 0.5))
+    flip = jax.random.bernoulli(_op_key(ctx), prob, (x.shape[0],))
+    cond = flip.reshape((-1,) + (1,) * (x.ndim - 1))
+    ctx.set_output("Out", jnp.where(cond, x[..., ::-1], x))
+
+
+@register_op("image_normalize", no_grad_slots=["X"])
+def _image_normalize(ctx):
+    """(x * scale - mean) / std per channel, emitting attr `dtype`
+    (default float32; "bfloat16" is the TPU training path). Input is
+    typically the reader's raw uint8 CHW batch — the cast and the
+    normalize arithmetic run in f32 on device and only the final
+    narrow happens, so bf16 output loses no normalize precision and the
+    decode host never touches float pixels at all."""
+    x = ctx.input("X")
+    if x.ndim != 4:
+        raise ValueError(
+            f"image_normalize expects an NCHW batch, got rank {x.ndim}")
+    scale = float(ctx.attr("scale", 1.0))
+    mean = jnp.asarray(ctx.attr("mean"), jnp.float32).reshape(1, -1, 1, 1)
+    std = jnp.asarray(ctx.attr("std"), jnp.float32).reshape(1, -1, 1, 1)
+    out_dtype = jnp_dtype(ctx.attr("dtype", "float32"))
+    xf = x.astype(jnp.float32)
+    ctx.set_output("Out", ((xf * scale - mean) / std).astype(out_dtype))

@@ -24,9 +24,13 @@ __all__ = [
     "map_readers", "shuffle", "chain", "compose", "buffered", "firstn",
     "xmap_readers", "batch", "double_buffer", "cache", "ComposeNotAligned",
     "multiprocess_batch_reader", "FeedPrefetcher",
+    "StreamingConfig", "StreamingInputService", "iter_stream",
+    "RawDecoder",
 ]
 
 from .multiprocess import multiprocess_batch_reader  # noqa: E402
+from .streaming import (RawDecoder, StreamingConfig,  # noqa: E402
+                        StreamingInputService, iter_stream)
 
 
 class ComposeNotAligned(ValueError):
@@ -373,6 +377,13 @@ class FeedPrefetcher:
             self.close()
             raise payload
         return payload
+
+    def occupancy(self) -> int:
+        """Converted feeds currently parked (LIVE queue depth, not the
+        configured capacity) — the starvation signal the Trainer
+        publishes as paddle_tpu_train_prefetch_depth: 0 means the next
+        step will block on input."""
+        return self._q.qsize()
 
     # -- lifecycle -----------------------------------------------------
     def close(self, timeout: float = 5.0):

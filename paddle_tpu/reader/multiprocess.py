@@ -34,13 +34,49 @@ live.
 from __future__ import annotations
 
 import multiprocessing as mp
+import os
 import queue as _queue
+import traceback
+import uuid
 from multiprocessing import shared_memory
 from typing import Callable, Dict, Optional
 
 import numpy as np
 
-__all__ = ["multiprocess_batch_reader"]
+__all__ = ["multiprocess_batch_reader", "new_shm_segment",
+           "ensure_resource_tracker", "SHM_PREFIX"]
+
+#: all shared-memory segments this package creates carry this prefix plus
+#: the CONSUMER process pid, so tests (and operators) can audit
+#: /dev/shm/ptshm<pid>_* for leaks attributable to one process.
+SHM_PREFIX = "ptshm"
+
+
+def new_shm_segment(size: int, consumer_pid: int) -> shared_memory.SharedMemory:
+    """Create an auditable shared-memory segment: named
+    ptshm<consumer_pid>_<uuid> rather than the stdlib's anonymous psm_*,
+    so a leak is attributable to its owning reader process."""
+    name = f"{SHM_PREFIX}{consumer_pid}_{uuid.uuid4().hex[:12]}"
+    return shared_memory.SharedMemory(create=True, name=name,
+                                      size=max(size, 1))
+
+
+def ensure_resource_tracker() -> None:
+    """Start multiprocessing's resource-tracker daemon from the
+    CONSUMER process before any worker forks. Without this, the first
+    shared-memory registration happens inside a worker, which lazily
+    starts the tracker as *that worker's* child — the consumer then
+    starts a second tracker and the two ledgers disagree: one reports
+    the other's properly-unlinked segments as leaked at shutdown (and a
+    SIGKILLed worker's tracker dies with it). One tracker, started
+    here, makes every register/unregister land in one ledger where
+    create-side and attach-side registrations dedupe (bpo-39959) and
+    the single successful unlink balances them."""
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.ensure_running()
+    except (ImportError, AttributeError, OSError):
+        pass
 
 class _EscapedSegment(shared_memory.SharedMemory):
     """Consumer-side segment a yielded view escaped into user code:
@@ -57,7 +93,7 @@ class _EscapedSegment(shared_memory.SharedMemory):
 
 
 def _worker_main(worker_fn, widx, nworkers, slots, free_q, full_q,
-                 stop_ev, kwargs):
+                 stop_ev, kwargs, consumer_pid):
     shms = []
     layout = None
     try:
@@ -70,9 +106,7 @@ def _worker_main(worker_fn, widx, nworkers, slots, free_q, full_q,
                 layout = [(a.shape, str(a.dtype)) for a in arrays]
                 total = sum(a.nbytes for a in arrays)
                 for _ in range(slots):
-                    shm = shared_memory.SharedMemory(create=True,
-                                                     size=max(total, 1))
-                    shms.append(shm)
+                    shms.append(new_shm_segment(total, consumer_pid))
                 full_q.put(("meta", widx, [s.name for s in shms], layout))
                 for i in range(slots):
                     free_q.put(i)
@@ -97,7 +131,11 @@ def _worker_main(worker_fn, widx, nworkers, slots, free_q, full_q,
             full_q.put(("batch", widx, slot))
     except BaseException as e:  # noqa: BLE001 — re-raised in the consumer
         try:
-            full_q.put(("error", widx, repr(e)[:500]))
+            # ship the full worker-side traceback: the consumer raises
+            # it verbatim, so a decode bug points at the worker's frame,
+            # not at an opaque queue read
+            full_q.put(("error", widx, repr(e)[:500],
+                        traceback.format_exc()[-4000:]))
         except BaseException:
             pass
     finally:
@@ -151,6 +189,7 @@ def multiprocess_batch_reader(worker_fn: Callable, num_workers: int,
         raise ValueError("num_workers must be >= 1")
 
     def reader():
+        ensure_resource_tracker()
         ctx = mp.get_context(method)
         full_q = ctx.Queue()
         free_qs = [ctx.Queue() for _ in range(num_workers)]
@@ -159,7 +198,8 @@ def multiprocess_batch_reader(worker_fn: Callable, num_workers: int,
             ctx.Process(
                 target=_worker_main,
                 args=(worker_fn, w, num_workers, slots_per_worker,
-                      free_qs[w], full_q, stop_ev, worker_kwargs),
+                      free_qs[w], full_q, stop_ev, worker_kwargs,
+                      os.getpid()),
                 daemon=True)
             for w in range(num_workers)]
         for p in procs:
@@ -176,8 +216,9 @@ def multiprocess_batch_reader(worker_fn: Callable, num_workers: int,
                 try:
                     msg = full_q.get(timeout=2.0)
                 except _queue.Empty:
-                    # a worker killed before announcing anything (OOM,
-                    # spawn failure) would otherwise hang this get
+                    # a worker killed without a farewell (OOM, SIGKILL,
+                    # os._exit mid-stream) would otherwise stall this
+                    # get forever: its "done"/"error" never arrives
                     for w, p in enumerate(procs):
                         if w not in dead_checked and not p.is_alive():
                             dead_checked.add(w)
@@ -185,8 +226,10 @@ def multiprocess_batch_reader(worker_fn: Callable, num_workers: int,
                             if p.exitcode not in (0, None):
                                 raise RuntimeError(
                                     f"reader worker {w} died with exit "
-                                    f"code {p.exitcode} before "
-                                    "announcing results")
+                                    f"code {p.exitcode} without "
+                                    "reporting an error (killed or "
+                                    "crashed hard); in-flight batches "
+                                    "from it are lost")
                     continue
                 kind = msg[0]
                 if kind == "done":
@@ -197,7 +240,8 @@ def multiprocess_batch_reader(worker_fn: Callable, num_workers: int,
                         active -= 1
                 elif kind == "error":
                     raise RuntimeError(
-                        f"reader worker {msg[1]} failed: {msg[2]}")
+                        f"reader worker {msg[1]} failed: {msg[2]}\n"
+                        f"--- worker traceback ---\n{msg[3]}")
                 elif kind == "meta":
                     _, widx, names, layout = msg
                     shms = [shared_memory.SharedMemory(name=n)

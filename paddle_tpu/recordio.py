@@ -45,10 +45,36 @@ class Writer:
 
 
 class Scanner:
+    """Sequential record scanner with a seekable cursor: `skip(n)`
+    advances past n records without surfacing them (the format has no
+    index, so a seek is a sequential read of the chunk stream — cheap
+    relative to decode, which a skip never runs). `position` counts
+    records consumed so far; (path, position) is a durable shard cursor
+    the streaming input plane checkpoints mid-epoch
+    (reader/streaming.py)."""
+
     def __init__(self, path: str):
         self._h = lib().rio_scanner_open(path.encode())
         if not self._h:
             raise IOError(last_error())
+        self.position = 0
+
+    def skip(self, n: int) -> int:
+        """Advance past up to n records; returns how many were actually
+        skipped (fewer at end-of-file). Iteration continues from the new
+        cursor."""
+        cnt = ctypes.c_uint64()
+        for i in range(n):
+            if self._h is None:
+                raise ValueError("skip on closed Scanner")
+            p = lib().rio_scanner_next(self._h, ctypes.byref(cnt))
+            if not p:
+                err = last_error()
+                if err:
+                    raise IOError(err)
+                return i
+            self.position += 1
+        return n
 
     def __iter__(self) -> Iterator[bytes]:
         n = ctypes.c_uint64()
@@ -61,6 +87,7 @@ class Scanner:
                 if err:
                     raise IOError(err)
                 return
+            self.position += 1
             yield ctypes.string_at(p, n.value)
 
     def close(self):
@@ -86,6 +113,17 @@ def write_recordio(records: Iterable[bytes], path: str,
 def read_recordio(path: str) -> List[bytes]:
     with Scanner(path) as s:
         return list(s)
+
+
+def count_records(path: str) -> int:
+    """Total records in a shard (one sequential pass; the format has no
+    index). Utility for shard tooling and tests — the streaming input
+    plane learns per-shard batch totals from its workers' end-of-shard
+    messages rather than pre-scanning."""
+    with Scanner(path) as s:
+        while s.skip(1 << 16) == (1 << 16):
+            pass
+        return s.position
 
 
 class DataLoader:

@@ -27,6 +27,14 @@ Registered fault points (armed sites, see each caller):
                         doubles the call rate; arm schedules
                         accordingly or build the prefetcher with
                         fire_faults=False
+    reader.shard        reader/streaming.py per shard-batch produced in
+                        a StreamingInputService WORKER PROCESS (an
+                        injected raise kills the worker, exercising
+                        crash-detect -> respawn). Workers inherit the
+                        armed injector only under the "fork" start
+                        method; under "spawn" the point is inert in
+                        workers (also fired by the single-process
+                        iter_stream reference path, in-process)
     dataset.download    dataset/common.py download fetch attempt
 
 Design: `fire(point)` is on hot paths (per batch, per RPC), so the
@@ -57,7 +65,7 @@ __all__ = ["FaultInjector", "FaultError", "fire", "active", "FAULT_POINTS"]
 FAULT_POINTS = frozenset({
     "checkpoint.write", "checkpoint.read", "master.rpc", "pserver.push",
     "serving.batch", "serving.swap", "serving.admission", "reader.next",
-    "dataset.download",
+    "reader.shard", "dataset.download",
 })
 
 _active: Optional["FaultInjector"] = None

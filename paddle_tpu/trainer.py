@@ -125,6 +125,13 @@ class Trainer:
         self.step = 0
         self.checkpoint_failures = 0
         self.last_checkpoint_error = None
+        # streaming-input integration: the service train() is consuming
+        # (cursor checkpointed beside the weights) and a restored
+        # cursor waiting to seed the next service passed to train()
+        self._input_service = None
+        self._service_base = 0
+        self._service_consumed = 0
+        self._resume_input_state = None
 
     def _verify_programs(self):
         """Static verification of the (main, startup) pair, once at
@@ -169,6 +176,9 @@ class Trainer:
                                    retry=self.checkpoint_config.retry)
             if meta:
                 self.step = int(meta.get("step", 0))
+                # a streaming-input cursor saved with the checkpoint is
+                # handed to the next StreamingInputService train() gets
+                self._resume_input_state = meta.get("input_state")
         self._started = True
         return self
 
@@ -227,15 +237,40 @@ class Trainer:
         emitted inside — feed assembly, dispatch, RPC attempts — share
         one trace id per step), and the loop publishes
         paddle_tpu_train_steps_total / _step_seconds / _prefetch_depth
-        to the metrics registry. step_seconds is host-side
-        dispatch-to-dispatch wall time per batch: with async dispatch
-        it measures sustained throughput, not device latency."""
+        (LIVE prefetch-queue occupancy; the configured depth is the
+        separate _prefetch_depth_config gauge) to the metrics registry.
+        step_seconds is host-side dispatch-to-dispatch wall time per
+        batch: with async dispatch it measures sustained throughput,
+        not device latency.
+
+        `reader` may also be a reader.StreamingInputService: batches
+        then come from the sharded multi-process input service, the
+        service's delivered-batch cursor is checkpointed beside the
+        weights, and a checkpoint resume re-seeds it (mid-epoch exact:
+        no record replayed or skipped). Service epochs live in its
+        config — call with num_passes=1."""
         from .observability import attribution as obs_attr
         from .observability import trace as obs_trace
         from .observability.registry import default_registry
 
         if not self._started:
             self.start()
+        if getattr(reader, "is_streaming_input_service", False):
+            # service-backed input: reader= is a StreamingInputService.
+            # Its epochs live in the service config (use num_passes=1);
+            # the delivered-batch cursor is checkpointed beside the
+            # weights and a checkpoint restore re-seeds it, so resume
+            # neither replays nor skips records.
+            service = reader
+            if self._resume_input_state is not None:
+                service.restore(self._resume_input_state)
+                self._resume_input_state = None
+            reader = service.reader
+            self._input_service = service
+            self._service_base = service.delivered
+            self._service_consumed = 0
+        else:
+            self._input_service = None
         handler = event_handler or (lambda e: None)
         fetch_names = list(self.fetch_metrics)
         fetch_list = [self.loss] + [self.fetch_metrics[k]
@@ -271,10 +306,17 @@ class Trainer:
                 "Host-side wall time per training step "
                 "(dispatch-to-dispatch / batches per dispatch; under "
                 "async dispatch this is throughput, not device latency).")
-            reg.gauge(
+            m_pref = reg.gauge(
                 "paddle_tpu_train_prefetch_depth",
-                "FeedPrefetcher depth of the current train() call "
-                "(0 = inline feed assembly).").set(prefetch)
+                "LIVE FeedPrefetcher queue occupancy sampled at each "
+                "dispatch (0 = the loop is about to block on input — "
+                "the starvation signal elastic input scaling watches; "
+                "always 0 with prefetch=0 inline feeds).")
+            m_pref.set(0)
+            reg.gauge(
+                "paddle_tpu_train_prefetch_depth_config",
+                "Configured prefetch= depth of the current train() "
+                "call (0 = inline feed assembly).").set(prefetch)
         if attr_on:
             m_mfu = obs_attr.mfu_gauge(reg, "train")
             m_flops = obs_attr.model_flops_gauge(reg, "train")
@@ -410,6 +452,8 @@ class Trainer:
                                     res.fetches()
                         pending.append(res)
                         self.step += len(group)
+                        if self._input_service is not None:
+                            self._service_consumed += len(group)
                         logged = (dispatch_id + 1) % log_every == 0
                         ev = EndIteration(pass_id, dispatch_id,
                                           result=res,
@@ -433,6 +477,8 @@ class Trainer:
                         wall = now - t_prev
                         m_steps.inc(len(group))
                         m_step_s.record(wall / len(group))
+                        m_pref.set(prefetcher.occupancy()
+                                   if prefetcher is not None else 0)
                         t_prev = now
                         if attr_on:
                             # phase breakdown: measured host phases
@@ -486,10 +532,21 @@ class Trainer:
             # (save_checkpoint itself runs the Executor.synchronize
             # barrier before snapshotting, covering every caller)
             try:
+                extra = None
+                if self._input_service is not None:
+                    # cursor of the TRAINED position (consumed count),
+                    # not the prefetcher's read-ahead — resume
+                    # re-produces the prefetched-but-untrained batches.
+                    # Inside the try: a cursor-lookup failure is a
+                    # checkpoint failure (warn path), not a run killer
+                    extra = {"input_state":
+                             self._input_service.state_for(
+                                 self._service_base
+                                 + self._service_consumed)}
                 save_checkpoint(cc.dirname, step=self.step,
                                 main_program=self.main_program,
                                 executor=self.exe, max_keep=cc.max_keep,
-                                retry=cc.retry)
+                                extra_meta=extra, retry=cc.retry)
             except Exception as e:
                 # checkpointing is off the training math path: a failed
                 # save (after retries) must not kill the run — the last

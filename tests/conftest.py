@@ -71,6 +71,36 @@ def no_prefetcher_thread_leak():
 
 
 @pytest.fixture(autouse=True)
+def no_reader_worker_leak():
+    """Reader worker PROCESSES and their shared-memory segments must not
+    outlive their test: multiprocess_batch_reader and
+    StreamingInputService both spawn multiprocessing children and
+    allocate /dev/shm ring slots named ptshm<pid>_* (pid = this
+    process); a leak here starves later tests of cores and shm."""
+    import glob
+    import multiprocessing as _mp
+    import time
+
+    def segs():
+        return glob.glob(f"/dev/shm/ptshm{os.getpid()}_*")
+
+    assert not segs(), \
+        f"shared-memory segment(s) leaked from a previous test: {segs()}"
+    yield
+    # workers exiting after a service stop may need a beat to be reaped
+    deadline = time.monotonic() + 5.0
+    while _mp.active_children() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    leaked = _mp.active_children()
+    assert not leaked, f"test leaked reader worker process(es): {leaked}"
+    deadline = time.monotonic() + 2.0
+    while segs() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert not segs(), \
+        f"test leaked shared-memory segment(s): {segs()}"
+
+
+@pytest.fixture(autouse=True)
 def no_fault_injector_leak():
     """The FaultInjector must be inert outside an explicit scope: no test
     may start with one armed, and none may leak one (chaos in one test

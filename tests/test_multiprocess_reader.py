@@ -25,6 +25,13 @@ def _failing(worker_idx, num_workers):
     raise ValueError("decode exploded")
 
 
+def _hard_crashing(worker_idx, num_workers):
+    import os
+    yield np.zeros((2, 2), np.float32),
+    yield np.ones((2, 2), np.float32),
+    os._exit(3)  # simulates OOM-kill/segfault: no farewell message
+
+
 def test_all_batches_arrive_once():
     reader = multiprocess_batch_reader(_batches, num_workers=3,
                                        slots_per_worker=2, method="fork")
@@ -54,5 +61,34 @@ def test_worker_error_propagates():
     reader = multiprocess_batch_reader(_failing, num_workers=1,
                                        slots_per_worker=2, method="fork")
     with pytest.raises(RuntimeError, match="decode exploded"):
+        for _ in reader():
+            pass
+
+
+def test_worker_error_carries_worker_traceback():
+    """Satellite (ISSUE 10): the consumer-side RuntimeError embeds the
+    worker's own traceback, so a decode bug points at the worker frame
+    that raised, not at an opaque queue read."""
+    reader = multiprocess_batch_reader(_failing, num_workers=1,
+                                       slots_per_worker=2, method="fork")
+    with pytest.raises(RuntimeError) as exc_info:
+        for _ in reader():
+            pass
+    msg = str(exc_info.value)
+    assert "worker traceback" in msg
+    assert "_failing" in msg          # the worker-side frame is named
+    assert "decode exploded" in msg
+
+
+def test_worker_hard_crash_raises_instead_of_stalling():
+    """Satellite (ISSUE 10): a worker that dies without a farewell
+    message (SIGKILL, os._exit, OOM) must surface as a raised exception
+    on the consumer, not a silent stall of the result queue."""
+    reader = multiprocess_batch_reader(_hard_crashing, num_workers=1,
+                                       slots_per_worker=2, method="fork")
+    # the contract is raise-not-stall; how many pre-crash batches make
+    # it through is timing (os._exit kills the queue feeder thread
+    # mid-flush — under load even the first message can be lost)
+    with pytest.raises(RuntimeError, match="exit code 3"):
         for _ in reader():
             pass
