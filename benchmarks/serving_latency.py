@@ -26,6 +26,15 @@ Arms (ISSUE 7):
                      between successful completions around the swap —
                      ~0 target), client-visible errors (0 target), shed
                      rate, and admitted-request p99
+    --arm decode     token serving (serving.generation): A/B the
+                     donated-KV incremental decode against the full
+                     re-forward baseline per cache depth (the gap must
+                     GROW with sequence length — re-forward is
+                     quadratic where cached decode is linear), then
+                     drive a two-model GenerationHost open-loop at ~2x
+                     its calibrated capacity and report decode
+                     tokens/sec/user, goodput, p99, and the per-model
+                     shed ledger
 """
 from __future__ import annotations
 
@@ -339,9 +348,201 @@ def run_hotswap_arm(args, serving, model_dir):
     }
 
 
+def run_decode_arm(args):
+    """Token-serving arm: per-depth cached-vs-reforward step A/B, then
+    a mixed two-model 2x-overload drive through a GenerationHost."""
+    from paddle_tpu.serving.admission import ServiceOverloadedError
+    from paddle_tpu.serving.batcher import QueueFullError
+    from paddle_tpu.serving.generation import (GenerationConfig,
+                                               GenerationHost,
+                                               GenerationModel,
+                                               GenerationSpec,
+                                               bucket_for)
+
+    buckets = sorted(set(int(b) for b in args.decode_buckets.split(",")))
+    max_seq = buckets[-1]
+    spec = GenerationSpec(
+        vocab_size=args.decode_vocab, max_seq_len=max_seq,
+        slots=args.decode_slots, prompt_buckets=buckets,
+        cache_buckets=buckets, n_layer=args.decode_layers,
+        n_head=4, d_model=args.decode_d_model,
+        d_inner=2 * args.decode_d_model, seed=0, eos_id=0)
+    model = GenerationModel.build(spec)
+    slots = spec.slots
+
+    # ---- A/B: one step at depth L, cached vs full re-forward ---------
+    rng = np.random.RandomState(0)
+    rounds = 3
+
+    def time_cached(depth, repeats):
+        bucket = bucket_for(depth, spec.cache_buckets)
+        tokens = rng.randint(1, spec.vocab_size, slots).astype(np.int64)
+        positions = np.full(slots, depth - 1, np.int64)
+        model.run_decode(tokens, positions, bucket)  # warm the bucket
+        t0 = time.monotonic()
+        for _ in range(repeats):
+            model.run_decode(tokens, positions, bucket)
+        return (time.monotonic() - t0) / repeats
+
+    def time_reforward(depth, repeats):
+        bucket = bucket_for(depth, spec.prompt_buckets)
+        matrix = rng.randint(
+            1, spec.vocab_size, (slots, bucket)).astype(np.int64)
+        lengths = np.full(slots, depth, np.int64)
+        model.run_full(matrix, lengths, bucket)  # warm the bucket
+        t0 = time.monotonic()
+        for _ in range(repeats):
+            model.run_full(matrix, lengths, bucket)
+        return (time.monotonic() - t0) / repeats
+
+    ab = []
+    for depth in buckets:
+        cached_s, reforward_s = [], []
+        for _ in range(rounds):
+            cached_s.append(time_cached(depth, args.decode_repeats))
+            reforward_s.append(time_reforward(depth, args.decode_repeats))
+
+        def spread(xs):
+            xs = sorted(xs)
+            med = xs[len(xs) // 2]
+            return round(100.0 * (xs[-1] - xs[0]) / med, 1) if med else 0.0
+
+        c, r = min(cached_s), min(reforward_s)
+        ab.append({
+            "depth": depth,
+            "cached_step_ms": round(c * 1e3, 3),
+            "reforward_step_ms": round(r * 1e3, 3),
+            "cached_tokens_per_s": round(slots / c, 1),
+            "reforward_tokens_per_s": round(slots / r, 1),
+            "speedup": round(r / c, 2) if c else None,
+            "cached_spread_pct": spread(cached_s),
+            "reforward_spread_pct": spread(reforward_s),
+        })
+    # re-forward is O(L^2) per token where cached decode is O(L): the
+    # advantage must widen with depth
+    gap_growth = (ab[-1]["speedup"] is not None and
+                  ab[0]["speedup"] is not None and
+                  ab[-1]["speedup"] > ab[0]["speedup"])
+
+    # ---- mixed two-model host at ~2x capacity ------------------------
+    cfg = GenerationConfig(max_new_tokens=args.decode_new_tokens,
+                           queue_capacity=4 * slots, idle_wait_s=0.002)
+    host = GenerationHost(config=cfg, default_budget=2 * slots)
+    host.deploy("m0", spec)  # same spec, built onto the host executor
+    host.deploy("m1", GenerationSpec(**{**spec.to_dict(), "seed": 1}))
+    models = ["m0", "m1"]
+    prompt_len = max(1, buckets[0] // 2)
+
+    def one_request(i):
+        prompt = list(rng.randint(1, spec.vocab_size, prompt_len))
+        return host.submit(models[i % 2], prompt)
+
+    # closed-loop calibration: sustainable request rate
+    calib_done, stop = [0], threading.Event()
+    lock = threading.Lock()
+
+    def calib_client(i):
+        while not stop.is_set():
+            try:
+                one_request(i).result(timeout=60)
+            except Exception:
+                continue
+            with lock:
+                calib_done[0] += 1
+
+    threads = [threading.Thread(target=calib_client, args=(i,),
+                                daemon=True) for i in range(2 * slots)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(args.decode_calib_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    capacity_rps = calib_done[0] / (time.monotonic() - t0)
+
+    # open-loop at 2x: goodput + p99 + sheds, per-user token rate
+    offered_rps = max(2.0, 2.0 * capacity_rps)
+    period = 1.0 / offered_rps
+    completed, shed, failed, latencies, tokens_out = [0], [0], [0], [], [0]
+    waiters = []
+
+    def wait_on(fut, t_submit):
+        try:
+            res = fut.result(timeout=120)
+        except Exception:
+            with lock:
+                failed[0] += 1
+            return
+        with lock:
+            completed[0] += 1
+            latencies.append(time.monotonic() - t_submit)
+            tokens_out[0] += len(res.tokens)
+
+    t_start = time.monotonic()
+    i = 0
+    while time.monotonic() - t_start < args.duration:
+        t_submit = time.monotonic()
+        try:
+            fut = one_request(i)
+        except (ServiceOverloadedError, QueueFullError):
+            with lock:
+                shed[0] += 1
+        except Exception:
+            with lock:
+                failed[0] += 1
+        else:
+            w = threading.Thread(target=wait_on, args=(fut, t_submit),
+                                 daemon=True)
+            w.start()
+            waiters.append(w)
+        i += 1
+        sleep = t_submit + period - time.monotonic()
+        if sleep > 0:
+            time.sleep(sleep)
+    for w in waiters:
+        w.join(timeout=120)
+    elapsed = time.monotonic() - t_start
+    host_stats = host.stats()
+    host.stop(drain=True, timeout=120)
+    offered = i
+    users = 2 * slots  # concurrent request streams the host can seat
+    return {
+        "benchmark": "serving_latency",
+        "arm": "decode",
+        "slots": slots,
+        "buckets": buckets,
+        "new_tokens_per_request": args.decode_new_tokens,
+        "ab_cached_vs_reforward": ab,
+        "gap_grows_with_depth": gap_growth,
+        "overload": {
+            "models": models,
+            "capacity_rps": round(capacity_rps, 2),
+            "offered_rps": round(offered_rps, 2),
+            "offered": offered,
+            "completed": completed[0],
+            "shed": shed[0],
+            "failed": failed[0],
+            "goodput_rps": round(completed[0] / elapsed, 2),
+            "goodput_ratio": round(completed[0] / offered, 3)
+            if offered else 0.0,
+            "latency_ms": _percentiles_ms(latencies),
+            "decode_tokens_per_s": round(tokens_out[0] / elapsed, 1),
+            "decode_tokens_per_s_per_user": round(
+                tokens_out[0] / elapsed / users, 2),
+            "shed_by_model": {
+                name: s["shed_by_reason"]
+                for name, s in ((n, host_stats["models"][n])
+                                for n in models)},
+        },
+        "compile_cache": host_stats.get("compile_cache"),
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--arm", choices=["baseline", "overload", "hotswap"],
+    p.add_argument("--arm",
+                   choices=["baseline", "overload", "hotswap", "decode"],
                    default="baseline")
     p.add_argument("--clients", type=int, default=8,
                    help="closed-loop client threads")
@@ -354,7 +555,27 @@ def main():
     p.add_argument("--in_dim", type=int, default=784)
     p.add_argument("--canary_fraction", type=float, default=0.1,
                    help="hotswap arm: canary routing fraction")
+    p.add_argument("--decode_buckets", default="32,64,128,256",
+                   help="decode arm: cache-length buckets (the A/B "
+                   "depths), comma-separated ascending")
+    p.add_argument("--decode_slots", type=int, default=4,
+                   help="decode arm: in-flight slots per model")
+    p.add_argument("--decode_vocab", type=int, default=512)
+    p.add_argument("--decode_layers", type=int, default=2)
+    p.add_argument("--decode_d_model", type=int, default=64)
+    p.add_argument("--decode_new_tokens", type=int, default=8,
+                   help="decode arm: tokens generated per request in "
+                   "the overload drive")
+    p.add_argument("--decode_repeats", type=int, default=10,
+                   help="decode arm: timed steps per A/B measurement")
+    p.add_argument("--decode_calib_s", type=float, default=3.0,
+                   help="decode arm: closed-loop capacity calibration "
+                   "seconds")
     args = p.parse_args()
+
+    if args.arm == "decode":
+        print(json.dumps(run_decode_arm(args), indent=2))
+        return
 
     from paddle_tpu import serving
 

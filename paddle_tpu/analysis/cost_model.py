@@ -49,7 +49,8 @@ ZERO_FLOP_OPS = frozenset({
     "flatten", "transpose", "transpose2", "concat", "split", "slice",
     "strided_slice", "cast", "one_hot", "stack", "unstack", "expand",
     "expand_as", "tile", "reverse", "pad", "pad2d", "gather",
-    "gather_nd", "lookup_table", "embedding_bag",
+    "gather_nd", "lookup_table", "embedding_bag", "kv_cache_write",
+    "kv_cache_append",
 })
 
 #: FLOPs per parameter element for each optimizer update rule (read +
@@ -372,6 +373,40 @@ def _bytes_override(op: ir.OpDesc,
                 if v is not None:
                     ids += v.bytes
         return 2 * touched + ids, "gather: touched rows only"
+    if op.type in ("kv_cache_write", "kv_cache_append"):
+        # an in-place dynamic-update-slice touches the UPDATED rows,
+        # not the whole cache: counting the full [slots, h, max_seq, d]
+        # cache as read+written per decoded token would overstate
+        # decode-step traffic by max_seq/1 and crater reported
+        # arithmetic intensity. The cache-READ traffic of attention is
+        # booked on the consumer (slice + scaled_dot_product_attention
+        # operands), not here.
+        new_b = 0
+        names = op.input("New")
+        if names:
+            v = lookup(names[0])
+            if v is not None:
+                new_b = v.bytes
+        idx = 0
+        for slot in ("Slot", "Pos"):
+            v_names = op.input(slot)
+            if v_names:
+                v = lookup(v_names[0])
+                if v is not None:
+                    idx += v.bytes
+        return 2 * new_b + idx, "kv cache: updated rows only"
+    if op.type == "slice":
+        # a slice reads exactly the rows it keeps — the decode step
+        # slices the first L rows out of a [slots, h, max_seq, d]
+        # cache, and charging the full cache read here would double the
+        # whole point of cache-length bucketing
+        out_b = 0
+        for names in op.outputs.values():
+            for n in names:
+                v = lookup(n)
+                if v is not None:
+                    out_b += v.bytes
+        return 2 * out_b, "slice: kept rows only"
     return None
 
 

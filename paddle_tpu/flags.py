@@ -173,6 +173,22 @@ FLAGS: Dict[str, tuple] = {
         "3", "reader/streaming.py",
         "total worker respawns a StreamingInputService attempts across "
         "its lifetime before surfacing the crash to the consumer"),
+    "PADDLE_TPU_DECODE_SLOTS": (
+        "4", "serving/generation/model.py",
+        "default in-flight slot count of a generation model's "
+        "continuous-batching array (per-request KV-cache rows; also "
+        "the decode executable's batch dimension)"),
+    "PADDLE_TPU_DECODE_CACHE_BUCKETS": (
+        "16,32,64", "serving/generation/model.py",
+        "default cache-length buckets for the decode-step executables, "
+        "comma-separated ascending; each bucket is one compiled "
+        "executable, a step runs the smallest bucket covering the "
+        "deepest active position"),
+    "PADDLE_TPU_DECODE_MODEL_BUDGET": (
+        "8", "serving/generation/host.py",
+        "default per-model admission budget of a GenerationHost: max "
+        "concurrently admitted (queued + in-flight) requests per "
+        "hosted model before sheds with reason=model_budget"),
     "PADDLE_TPU_BN_CUSTOM_VJP": (
         "0", "ops/nn_ops.py",
         "use the round-2 hand-written BatchNorm backward (custom_vjp) "

@@ -101,12 +101,17 @@ def load_persistables(executor, dirname, main_program=None, filename=None):
 
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
-                         params_filename=None, model_version=None):
+                         params_filename=None, model_version=None,
+                         generation_spec=None):
     """Freeze program + params for inference (reference: io.py:298 +
     framework/prune.cc pruning). `model_version` is an optional deploy
     identity stamped into the artifact metadata — the serving lifecycle
     (ModelHost hot-swap, the model_version gauge) reports it; absent on
-    artifacts saved before versioning existed."""
+    artifacts saved before versioning existed. `generation_spec` is an
+    optional JSON-able dict of token-serving parameters (max_seq_len,
+    KV-cache layout, eos id, bucket sets — GenerationSpec.to_dict());
+    with it the artifact is self-describing for
+    serving.generation.GenerationModel.load."""
     program = main_program or default_main_program()
     os.makedirs(dirname, exist_ok=True)
     fetch_names = [t.name for t in target_vars]
@@ -153,6 +158,17 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         # stale sidecar would stamp the previous artifact's identity
         # onto the new weights
         os.remove(version_path)
+    gen_path = os.path.join(dirname, "__generation__.json")
+    if generation_spec is not None:
+        meta["generation_spec"] = dict(generation_spec)
+        # like model_version: not re-derivable from the frozen program
+        # (the saved program is the cache-less re-forward baseline), so
+        # a JSON sidecar guarantees the round-trip even when the native
+        # PTIR writer drops unknown top-level meta keys
+        with open(gen_path, "w") as f:
+            json.dump(dict(generation_spec), f)
+    elif os.path.exists(gen_path):
+        os.remove(gen_path)  # same staleness hazard as __version__
     try:
         from .native import ProgramIR
         ProgramIR.from_json(json.dumps(meta)).save(
@@ -190,7 +206,8 @@ def load_inference_model(dirname, executor, model_filename=None,
         meta = meta.get("program", meta) | {
             k: meta[k] for k in ("feed_names", "fetch_names",
                                  "feed_specs", "fetch_specs",
-                                 "model_version") if k in meta}
+                                 "model_version", "generation_spec")
+            if k in meta}
     from .core import ir
     prog = Program()
     prog.desc = ir.Program.from_dict(meta)
@@ -213,9 +230,16 @@ def load_inference_model(dirname, executor, model_filename=None,
         if os.path.exists(vpath):  # PTIR writer dropped the meta key
             with open(vpath) as f:
                 model_version = f.read().strip() or None
+    generation_spec = meta.get("generation_spec")
+    if generation_spec is None:
+        gpath = os.path.join(dirname, "__generation__.json")
+        if os.path.exists(gpath):  # PTIR writer dropped the meta key
+            with open(gpath) as f:
+                generation_spec = json.load(f)
     return prog, meta["feed_names"], fetch_vars, {
         "feed_specs": feed_specs, "fetch_specs": fetch_specs,
-        "model_version": model_version}
+        "model_version": model_version,
+        "generation_spec": generation_spec}
 
 
 def _prune(program: Program, feed_names, fetch_names) -> Program:

@@ -25,3 +25,4 @@ from . import csp_ops  # noqa: F401
 from . import reader_ops  # noqa: F401
 from . import fusion_ops  # noqa: F401
 from . import augment_ops  # noqa: F401
+from . import cache_ops  # noqa: F401

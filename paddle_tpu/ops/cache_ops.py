@@ -1,0 +1,56 @@
+"""KV-cache update ops for incremental decode (serving/generation).
+
+The cache is persistable scope state shaped [slots, heads, max_seq, d]:
+an op here reads the cache var and writes its output back to the SAME
+var name, which makes the executor classify it read-write state and
+donate it to the jitted step (core/executor.py donate_argnums) — the
+update is an in-place XLA dynamic-update-slice, not a copy of the whole
+cache per token. This is exactly the optimizer-op ParamOut contract;
+the serving engine never fetches the cache, so donation is safe even
+under sync dispatch.
+
+Both rules are pure differentiable JAX, but generation never runs a
+backward pass — the index slots are marked no-grad so an accidental
+minimize() over a decode graph fails on the float paths only.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("kv_cache_write", no_grad_slots=["Slot"])
+def _kv_cache_write(ctx):
+    """Prefill path: write one request's full-prompt K or V rows into
+    its cache slot.
+
+    Cache: [slots, h, max_seq, d]; New: [1, h, S, d] (S <= max_seq);
+    Slot: [1] int — the in-flight batch slot index. Rows [0, S) of the
+    slot are overwritten; rows beyond S keep whatever the previous
+    occupant left (masked out by the decode-step attention mask).
+    """
+    cache = ctx.input("Cache")
+    new = ctx.input("New").astype(cache.dtype)
+    slot = ctx.input("Slot").reshape(()).astype(jnp.int32)
+    ctx.set_output("Out", jax.lax.dynamic_update_slice(
+        cache, new, (slot, 0, 0, 0)))
+
+
+@register_op("kv_cache_append", no_grad_slots=["Pos"])
+def _kv_cache_append(ctx):
+    """Decode path: append one token's K or V row per slot, at each
+    slot's own position.
+
+    Cache: [slots, h, max_seq, d]; New: [slots, h, 1, d]; Pos: [slots]
+    int — per-slot write position. Inactive slots point Pos at 0; the
+    garbage row is overwritten by that slot's next prefill and is never
+    attended to meanwhile (the additive mask covers only live rows).
+    """
+    cache = ctx.input("Cache")
+    new = ctx.input("New").astype(cache.dtype)
+    pos = ctx.input("Pos").astype(jnp.int32)
+    ctx.set_output("Out", jax.vmap(
+        lambda c, n, p: jax.lax.dynamic_update_slice(c, n, (0, p, 0)))(
+            cache, new, pos))

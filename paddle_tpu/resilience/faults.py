@@ -65,7 +65,7 @@ __all__ = ["FaultInjector", "FaultError", "fire", "active", "FAULT_POINTS"]
 FAULT_POINTS = frozenset({
     "checkpoint.write", "checkpoint.read", "master.rpc", "pserver.push",
     "serving.batch", "serving.swap", "serving.admission", "reader.next",
-    "reader.shard", "dataset.download",
+    "reader.shard", "dataset.download", "generation.step",
 })
 
 _active: Optional["FaultInjector"] = None

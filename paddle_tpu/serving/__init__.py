@@ -35,6 +35,10 @@ shedding with ServiceOverloadedError), `lifecycle.ModelHost` (atomic
 weight hot-swap: verifier deploy gate, shared-cache precompile, canary
 fraction with stable-fallback, automatic rollback),
 `metrics.ServingMetrics` (counters/histograms + stats()).
+
+Token serving (autoregressive generation) lives in the `generation`
+subpackage: continuous batching + donated-KV incremental decode +
+multi-model hosting — see serving/generation/__init__.py.
 """
 from ..resilience.health import (CircuitBreaker, CircuitOpenError,  # noqa
                                  HealthMonitor)
@@ -46,13 +50,14 @@ from .engine import ServingEngine  # noqa
 from .lifecycle import ModelHost, SwapError  # noqa
 from .metrics import ServingMetrics  # noqa
 from .model import ServableModel  # noqa
+from . import generation  # noqa
 
 __all__ = ["load", "ServableModel", "ServingEngine", "ServingMetrics",
            "BatchingConfig", "DynamicBatcher", "ServingFuture",
            "QueueFullError", "ServingStopped", "CircuitBreaker",
            "CircuitOpenError", "HealthMonitor", "ModelHost", "SwapError",
            "AdmissionConfig", "AdmissionController",
-           "ServiceOverloadedError"]
+           "ServiceOverloadedError", "generation"]
 
 
 def load(dirname, model_filename=None, params_filename=None):

@@ -1,0 +1,46 @@
+"""Token-serving engine: continuous batching over donated-KV
+incremental decode, with multi-model hosting.
+
+Layers (all on the SAME executor machinery the trainer and the batch
+server use — no bespoke runtime):
+
+- `model.GenerationModel` — one decoder LM's program family (prefill /
+  decode-step / re-forward baseline, models/transformer.py
+  build_decoder_lm), pinned weights and persistable ``kv_cache.*``
+  state in a private scope. The decode programs write the cache
+  through ops whose output IS the cache var, so the executor's
+  existing rw-state classification donates the buffers —
+  per-token decode updates the cache in place, no O(seq) copy.
+- `engine.GenerationEngine` — the continuous-batching driver: admit
+  into free slots at decode-step boundaries (one prefill each), one
+  bucketed single-token executable per step over the whole slot
+  array, per-request retirement (eos / max_new_tokens / length).
+  ``mode="reforward"`` is the no-cache ablation baseline; the token
+  streams are greedy and bit-comparable.
+- `host.GenerationHost` — N named models on ONE executor compile
+  cache, per-model budgets/breakers, probe-canaried hot swap that
+  drains (never drops) in-flight requests.
+
+Quick start::
+
+    from paddle_tpu.serving.generation import (GenerationModel,
+                                               GenerationSpec)
+    spec = GenerationSpec(vocab_size=1000, max_seq_len=64, eos_id=2)
+    model = GenerationModel.build(spec)
+    engine = model.serve().start()
+    result = engine.generate([5, 17, 9], max_new_tokens=8)
+    print(result.tokens, result.finish_reason)
+    engine.stop()
+"""
+from .engine import (GenerationConfig, GenerationEngine,
+                     GenerationFuture, GenerationResult)
+from .host import GenerationHost, GenerationSwapError
+from .metrics import GenerationMetrics
+from .model import GenerationModel, GenerationSpec, bucket_for
+
+__all__ = [
+    "GenerationSpec", "GenerationModel", "GenerationConfig",
+    "GenerationEngine", "GenerationFuture", "GenerationResult",
+    "GenerationHost", "GenerationSwapError", "GenerationMetrics",
+    "bucket_for",
+]

@@ -1,0 +1,347 @@
+"""Token-serving engine tests (ISSUE 16): greedy bit-identity of the
+donated-KV incremental decode against the full re-forward baseline,
+continuous-batching admit/retire mid-generation, donation
+non-interference with an in-flight training executor, chaos (breaker
+trip keeps completed tokens), multi-model hosting + swap, decode cost
+rules, and the generation-spec artifact round-trip."""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.analysis import cost_model
+from paddle_tpu.resilience.faults import FaultInjector
+from paddle_tpu.resilience.health import (CircuitBreaker,
+                                          CircuitOpenError, HealthMonitor)
+from paddle_tpu.serving.generation import (GenerationConfig,
+                                           GenerationHost,
+                                           GenerationModel,
+                                           GenerationSpec, bucket_for)
+
+SPEC_KW = dict(vocab_size=50, max_seq_len=24, slots=2,
+               prompt_buckets=(8, 16, 24), cache_buckets=(8, 16, 24),
+               n_layer=1, n_head=2, d_model=16, d_inner=32, seed=7,
+               eos_id=1)
+
+
+@pytest.fixture(scope="module")
+def model():
+    """One compiled model shared by every test in this module (each
+    engine run starts from whatever cache state the last one left —
+    prefill overwrites a slot's rows, so tests stay independent)."""
+    return GenerationModel.build(GenerationSpec(**SPEC_KW))
+
+
+def _generate_all(model, prompts, mode, max_new_tokens=16):
+    eng = model.serve(config=GenerationConfig(max_new_tokens=max_new_tokens),
+                      mode=mode).start()
+    try:
+        futs = [eng.submit(p) for p in prompts]
+        return [f.result(timeout=120) for f in futs]
+    finally:
+        eng.stop(drain=True, timeout=120)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity
+# ---------------------------------------------------------------------------
+def test_greedy_bit_identity_across_cache_buckets(model):
+    """Cached decode must be BIT-identical to full re-forward while the
+    generation crosses >= 3 cache buckets (prompt 4 -> length 22 spans
+    the 8, 16, and 24 buckets)."""
+    prompts = [[5, 9, 3, 2], [7, 3, 2, 4]]
+    cached = _generate_all(model, prompts, "cached", max_new_tokens=18)
+    reforward = _generate_all(model, prompts, "reforward",
+                              max_new_tokens=18)
+    for c, r in zip(cached, reforward):
+        assert c.tokens == r.tokens
+        assert c.finish_reason == r.finish_reason
+    # the run really did cross three buckets
+    final_len = len(prompts[0]) + len(cached[0].tokens)
+    spec = model.spec
+    crossed = {bucket_for(n, spec.cache_buckets)
+               for n in range(len(prompts[0]) + 1, final_len + 1)}
+    assert len(crossed) >= 3, (final_len, crossed)
+
+
+def test_mid_generation_admit_retire_bit_identity(model):
+    """Continuous batching: with 2 slots and 4 requests of different
+    lengths, late requests are admitted into slots freed mid-run by
+    early retirements — and every request's token stream still equals
+    its solo (no batchmates) run."""
+    prompts = [[5, 9, 3], [7, 3, 2, 4], [11, 6], [8, 8, 4, 9, 2]]
+    budgets = [4, 9, 6, 12]
+    eng = model.serve(config=GenerationConfig(max_new_tokens=16)).start()
+    try:
+        futs = [eng.submit(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        mixed = [f.result(timeout=120) for f in futs]
+    finally:
+        eng.stop(drain=True, timeout=120)
+    # retirements freed slots for the queued requests
+    assert eng.metrics.requests.value >= 4
+    for prompt, budget, got in zip(prompts, budgets, mixed):
+        solo = _generate_all(model, [prompt], "cached",
+                             max_new_tokens=budget)[0]
+        assert got.tokens == solo.tokens, (prompt, got.tokens,
+                                           solo.tokens)
+        assert got.finish_reason == solo.finish_reason
+
+
+# ---------------------------------------------------------------------------
+# donation non-interference
+# ---------------------------------------------------------------------------
+def test_donated_cache_does_not_disturb_train_executor(model):
+    """The decode step donates its KV-cache buffers. Run a training
+    loop (its OWN executor/scope, in-flight async dispatches) while the
+    generation engine decodes concurrently: the loss trajectory must be
+    bit-identical to the serial baseline — donation must never reach
+    across executors or corrupt the feed cache."""
+    def build_trainer():
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = startup.random_seed = 3
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [6])
+            y = layers.data("y", [1])
+            pred = layers.fc(x, size=1)
+            loss = layers.mean(layers.square(pred - y))
+            pt.optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
+        return main, startup, loss
+
+    def run_train(steps=12):
+        main, startup, loss = build_trainer()
+        scope = pt.Scope()
+        exe = pt.Executor()
+        rng = np.random.RandomState(0)
+        feeds = [{"x": rng.rand(4, 6).astype(np.float32),
+                  "y": rng.rand(4, 1).astype(np.float32)}
+                 for _ in range(steps)]
+        losses = []
+        with pt.scope_guard(scope):
+            exe.run(startup)
+            results = [exe.run(main, feed=f, fetch_list=[loss.name],
+                               sync=False) for f in feeds]
+            for r in results:  # materialize after ALL dispatches
+                losses.append(float(np.asarray(r.fetches()[0])))
+        return losses
+
+    baseline = run_train()
+
+    eng = model.serve(config=GenerationConfig(max_new_tokens=12)).start()
+    try:
+        futs = [eng.submit([5, 9, 3, 2]), eng.submit([7, 3, 2, 4])]
+        concurrent = run_train()  # decode steps interleave with these
+        gen = [f.result(timeout=120) for f in futs]
+    finally:
+        eng.stop(drain=True, timeout=120)
+    assert all(len(g.tokens) > 0 for g in gen)
+    assert concurrent == baseline
+
+
+# ---------------------------------------------------------------------------
+# chaos: breaker trip never drops completed tokens
+# ---------------------------------------------------------------------------
+def test_breaker_trip_preserves_completed_tokens(model):
+    """Inject a step fault mid-generation with a trip-on-first-failure
+    breaker: the in-flight request must resolve with the tokens it
+    already completed (finish_reason='aborted'), and the open breaker
+    must shed the next submit."""
+    solo = _generate_all(model, [[5, 9, 3, 2]], "cached",
+                         max_new_tokens=12)[0]
+    health = HealthMonitor(breaker=CircuitBreaker(failure_threshold=1,
+                                                  reset_timeout_s=3600))
+    eng = model.serve(config=GenerationConfig(max_new_tokens=12),
+                      health=health).start()
+    try:
+        with FaultInjector(seed=0) as fi:
+            fi.on("generation.step", after=2)  # steps 3+ fail
+            res = eng.submit([5, 9, 3, 2]).result(timeout=120)
+        assert res.finish_reason == "aborted"
+        # prefill token + 2 decode-step tokens survived the trip, and
+        # they are the true greedy prefix — nothing invented, nothing
+        # dropped
+        assert len(res.tokens) == 3
+        assert res.tokens == solo.tokens[:3]
+        assert eng.health.snapshot()["breaker"]["state"] == "open"
+        with pytest.raises(CircuitOpenError):
+            eng.submit([1, 2, 3])
+        shed = eng.metrics.stats()["shed_by_reason"]
+        assert shed.get("circuit_open") == 1, shed
+        # result finish_reason is "aborted" (partial stream delivered);
+        # the metrics ledger books the CAUSE: a step error
+        retired = eng.metrics.stats()["retired_by_reason"]
+        assert retired.get("error") == 1, retired
+    finally:
+        eng.stop(drain=False, timeout=120)
+
+
+def test_stop_without_drain_keeps_partial_tokens(model):
+    """stop(drain=False) mid-generation also resolves in-flight
+    requests with their completed tokens instead of dropping them."""
+    eng = model.serve(config=GenerationConfig(max_new_tokens=500,
+                                              idle_wait_s=0.005)).start()
+    fut = eng.submit([5, 9, 3], max_new_tokens=500)
+    # wait until at least one token exists, then pull the plug
+    deadline = threading.Event()
+    for _ in range(2000):
+        if eng.metrics.tokens.value >= 1:
+            break
+        deadline.wait(0.005)
+    eng.stop(drain=False, timeout=120)
+    res = fut.result(timeout=120)
+    assert res.finish_reason == "aborted"
+    assert len(res.tokens) >= 1
+
+
+# ---------------------------------------------------------------------------
+# multi-model hosting
+# ---------------------------------------------------------------------------
+def test_host_routes_budgets_and_swap_preserves_inflight():
+    spec_a = GenerationSpec(**SPEC_KW)
+    spec_b = GenerationSpec(**{**SPEC_KW, "seed": 11, "vocab_size": 40})
+    host = GenerationHost(config=GenerationConfig(max_new_tokens=6),
+                          default_budget=4)
+    host.deploy("a", spec_a)
+    host.deploy("b", spec_b)
+    try:
+        # both models serve from ONE executor compile cache
+        assert host._hosted["a"].model.executor is \
+            host._hosted["b"].model.executor
+        ra = host.generate("a", [5, 9, 3], timeout=120)
+        rb = host.generate("b", [7, 2], timeout=120)
+        assert ra.tokens and rb.tokens
+
+        # per-model budget shed leaves the OTHER model serving
+        host._hosted["a"].budget = 0
+        from paddle_tpu.serving.admission import ServiceOverloadedError
+        with pytest.raises(ServiceOverloadedError):
+            host.submit("a", [1, 2])
+        assert host.generate("b", [7, 2], timeout=120).tokens
+        host._hosted["a"].budget = 4
+
+        # swap model a mid-flight: the in-flight request must finish
+        # on the old weights (drain), new traffic hits the new model
+        old_solo = ra.tokens
+        fut = host.submit("a", [5, 9, 3])
+        report = host.swap("a", GenerationSpec(**{**SPEC_KW, "seed": 99}),
+                           probe_prompts=([3, 4],))
+        assert report["outcome"] == "completed", report
+        inflight = fut.result(timeout=120)
+        assert inflight.tokens == old_solo  # old weights, full stream
+        new = host.generate("a", [5, 9, 3], timeout=120)
+        assert new.tokens != old_solo  # genuinely the new weights
+
+        # swap rollback: a candidate whose probe fails leaves the old
+        # (post-swap) model serving untouched
+        bad = GenerationSpec(**{**SPEC_KW, "seed": 5})
+        with FaultInjector(seed=0) as fi:
+            fi.on("generation.step", times=1000)
+            report = host.swap("a", bad, probe_prompts=([3, 4],))
+        assert report["outcome"] == "rolled_back", report
+        assert host.generate("a", [5, 9, 3], timeout=120).tokens \
+            == new.tokens
+    finally:
+        host.stop(drain=True, timeout=120)
+
+
+# ---------------------------------------------------------------------------
+# cost model: cached-attention decode rules vs hand counts
+# ---------------------------------------------------------------------------
+def test_decode_cost_hand_counts(model):
+    spec = model.spec
+    L = spec.cache_buckets[0]  # 8
+    lm = model.programs["decode"][L]
+    cost = cost_model.program_cost(
+        lm.main, feed_shapes={"token_ids": (spec.slots, 1, 1),
+                              "positions": (spec.slots,)})
+    slots, h = spec.slots, spec.n_head
+    d_key = spec.d_model // spec.n_head
+    # SDPA mega-op: q len 1 against the L cached rows, per layer.
+    # flops = 4*lead*sq*sk*d + 5*lead*sq*sk with lead=slots*h, sq=1
+    sdpa = [c for c in cost.ops
+            if c.op_type == "scaled_dot_product_attention"]
+    assert len(sdpa) == spec.n_layer
+    expect_sdpa = 4 * (slots * h) * 1 * L * d_key + 5 * (slots * h) * 1 * L
+    for c in sdpa:
+        assert c.exact and c.flops == expect_sdpa, (c.flops, expect_sdpa)
+    # kv_cache_append: zero flops; bytes = 2 * new rows + index — the
+    # whole [slots, h, max_seq, d] cache must NOT be charged per token
+    appends = [c for c in cost.ops if c.op_type == "kv_cache_append"]
+    assert len(appends) == 2 * spec.n_layer  # k and v per layer
+    new_bytes = slots * h * 1 * d_key * 4      # [slots, h, 1, d] f32
+    pos_bytes = slots * 8                      # positions int64
+    for c in appends:
+        assert c.flops == 0
+        assert c.bytes_accessed == 2 * new_bytes + pos_bytes, \
+            (c.bytes_accessed, 2 * new_bytes + pos_bytes)
+    # slice reads only the kept L rows, not the max_seq cache
+    slices = [c for c in cost.ops if c.op_type == "slice"]
+    assert len(slices) == 2 * spec.n_layer
+    kept = slots * h * L * d_key * 4
+    for c in slices:
+        assert c.bytes_accessed == 2 * kept, (c.bytes_accessed, 2 * kept)
+    assert cost.unresolved == 0
+
+
+def test_prefill_cost_write_rows_only(model):
+    spec = model.spec
+    S = spec.prompt_buckets[0]
+    lm = model.programs["prefill"][S]
+    cost = cost_model.program_cost(
+        lm.main, feed_shapes={"token_ids": (1, S, 1), "lengths": (1,),
+                              "slot": (1,)})
+    writes = [c for c in cost.ops if c.op_type == "kv_cache_write"]
+    assert len(writes) == 2 * spec.n_layer
+    d_key = spec.d_model // spec.n_head
+    new_bytes = 1 * spec.n_head * S * d_key * 4  # one slot's S rows
+    slot_bytes = 8
+    for c in writes:
+        assert c.flops == 0
+        assert c.bytes_accessed == 2 * new_bytes + slot_bytes
+
+
+# ---------------------------------------------------------------------------
+# artifact round-trip
+# ---------------------------------------------------------------------------
+def test_generation_spec_save_load_roundtrip(tmp_path, model):
+    """save -> load must reproduce the decode stream from the SAVED
+    weights (not the spec's seed init): mutate a weight first so a
+    loader that silently re-randomizes from the seed fails loudly."""
+    src = GenerationModel.build(GenerationSpec(**SPEC_KW))
+    # perturb one parameter away from its seeded init
+    wname = next(n for n in src.scope.local_names()
+                 if "lm_head" in n and ".w" in n)
+    w = np.asarray(src.scope.find(wname))
+    src.scope.set(wname, np.asarray(w) + 0.37)
+    before = _generate_all(src, [[5, 9, 3]], "cached", max_new_tokens=8)[0]
+
+    d = str(tmp_path / "gen_model")
+    src.save(d, model_version="v7")
+
+    loaded = GenerationModel.load(d)
+    assert loaded.version == "v7"
+    assert loaded.spec == src.spec
+    after = _generate_all(loaded, [[5, 9, 3]], "cached",
+                          max_new_tokens=8)[0]
+    assert after.tokens == before.tokens
+    # the meta itself is readable without rebuilding a model
+    from paddle_tpu import io
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        _p, _f, _t, meta = io.load_inference_model(
+            d, pt.Executor(), return_meta=True)
+    gs = meta["generation_spec"]
+    assert gs["max_seq_len"] == SPEC_KW["max_seq_len"]
+    assert gs["eos_id"] == SPEC_KW["eos_id"]
+    assert gs["kv_cache_layout"] == "[slots, n_head, max_seq_len, d_key]"
+
+
+def test_new_decode_flags_registered():
+    from paddle_tpu import flags
+    for name in ("PADDLE_TPU_DECODE_SLOTS",
+                 "PADDLE_TPU_DECODE_CACHE_BUCKETS",
+                 "PADDLE_TPU_DECODE_MODEL_BUDGET"):
+        assert name in flags.FLAGS
+        assert flags.get(name)

@@ -60,7 +60,37 @@ def _smoke_train_and_serve(tmp_path):
         assert report["outcome"] == "completed"
     finally:
         host.stop(timeout=120)
+    _smoke_generation()
     return host.host_label
+
+
+def _smoke_generation():
+    """Populate the token-serving families (ISSUE 16): one tiny
+    GenerationHost deploy + a shed, so paddle_tpu_decode_* and the host
+    routing families all carry samples."""
+    from paddle_tpu.serving.admission import ServiceOverloadedError
+    from paddle_tpu.serving.generation import (GenerationConfig,
+                                               GenerationHost,
+                                               GenerationSpec)
+    spec = GenerationSpec(vocab_size=32, max_seq_len=8, slots=1,
+                          prompt_buckets=(8,), cache_buckets=(8,),
+                          n_layer=1, n_head=2, d_model=8, d_inner=16,
+                          seed=0, eos_id=0)
+    host = GenerationHost(config=GenerationConfig(max_new_tokens=2),
+                          default_budget=1)
+    host.deploy("gm", spec)
+    try:
+        host.generate("gm", [3, 4], timeout=60)
+        # drive one model_budget shed through the real admission path
+        host._hosted["gm"].budget = 0
+        try:
+            host.submit("gm", [5])
+        except ServiceOverloadedError:
+            pass
+        else:
+            raise AssertionError("budget=0 submit was not shed")
+    finally:
+        host.stop(timeout=120)
 
 
 def test_registry_names_and_help_after_smoke_run(tmp_path):
@@ -87,8 +117,26 @@ def test_registry_names_and_help_after_smoke_run(tmp_path):
                      "paddle_tpu_serving_canary_requests_total",
                      # ISSUE 8: rewrite-pipeline families
                      "paddle_tpu_rewrite_seconds",
-                     "paddle_tpu_rewrite_ops_total"):
+                     "paddle_tpu_rewrite_ops_total",
+                     # ISSUE 16: token-serving families
+                     "paddle_tpu_decode_requests_total",
+                     "paddle_tpu_decode_tokens_total",
+                     "paddle_tpu_decode_steps_total",
+                     "paddle_tpu_decode_prefills_total",
+                     "paddle_tpu_decode_retired_total",
+                     "paddle_tpu_decode_shed_total",
+                     "paddle_tpu_decode_step_seconds",
+                     "paddle_tpu_decode_prefill_seconds",
+                     "paddle_tpu_decode_slots_active",
+                     "paddle_tpu_decode_slots_total",
+                     "paddle_tpu_decode_host_requests_total",
+                     "paddle_tpu_decode_host_swaps_total",
+                     "paddle_tpu_decode_host_models"):
         assert expected in names, f"smoke run did not publish {expected}"
+    # the generation smoke shed exactly through the host budget path
+    gen_shed = {key for key, _ in
+                reg.get("paddle_tpu_decode_shed_total").samples()}
+    assert any(k[1] == "model_budget" for k in gen_shed), gen_shed
     # the smoke program carries a deliberately-dead op: the rewrite
     # ledger must book its removal under {pass="dce", action="remove_op"}
     rw = {key for key, _ in

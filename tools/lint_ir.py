@@ -168,10 +168,24 @@ def _build_ifelse():
     return main, startup, ["x"], [out.name]
 
 
+def _build_decoder_lm_step():
+    """The token-serving decode-step program: single-token forward
+    reading/writing the persistable KV cache through the donated
+    kv_cache_append ops (models/transformer.py build_decoder_lm)."""
+    from paddle_tpu.models.transformer import build_decoder_lm
+    programs = build_decoder_lm(
+        vocab_size=64, max_seq_len=16, slots=2, prompt_buckets=(8, 16),
+        cache_buckets=(8, 16), n_layer=1, n_head=2, d_model=16,
+        d_inner=32, seed=0)
+    lm = programs["decode"][16]
+    return lm.main, lm.startup, lm.feed_names, [lm.fetch_name]
+
+
 #: name -> builder returning (main, startup, feed_names, fetch_names).
 #: These mirror the network shapes the test suite runs (fc regression,
-#: the mnist book nets, sequence/lod pipelines, and every control-flow
-#: construct) — tests/test_lint_cli.py keeps each verifier-clean.
+#: the mnist book nets, sequence/lod pipelines, every control-flow
+#: construct, and the token-serving decode step) —
+#: tests/test_lint_cli.py keeps each verifier-clean.
 NETWORKS = {
     "fc_regression": _build_fc_regression,
     "mnist_mlp": lambda: _build_mnist("mlp"),
@@ -182,6 +196,7 @@ NETWORKS = {
     "static_rnn": _build_static_rnn,
     "dynamic_rnn": _build_dynamic_rnn,
     "ifelse": _build_ifelse,
+    "decoder_lm_step": _build_decoder_lm_step,
 }
 
 
