@@ -39,6 +39,7 @@ from . import observability  # noqa  (metrics registry, step tracing, telemetry 
 from . import analysis  # noqa  (static ProgramDesc verifier, lint passes, pre-compile gate)
 from . import resilience  # noqa  (fault injection, retry/backoff, circuit breaker)
 from . import serving  # noqa  (inference server: dynamic batching + bucketed compile cache)
+from . import embedding  # noqa  (billion-row sharded embedding subsystem)
 
 # reference fluid.__all__ surface (module paths a migrating user
 # imports directly; see each shim's docstring)

@@ -61,6 +61,15 @@ _OPTIMIZER_FLOPS = {
     "lars_momentum": 9, "proximal_gd": 6, "proximal_adagrad": 9,
 }
 
+#: same per-element rules for the sparse (touched-rows-only) variants
+#: (ops/optimizer_ops.py sparse_sgd/sparse_adagrad/sparse_adam) — but
+#: keyed on the DEDUPED row-grad numel, not Param numel: charging the
+#: dense rule's Param numel would overcount by vocab/touched, which at
+#: embedding scale is ~1e5x (PAPER sparse update path)
+_SPARSE_OPTIMIZER_FLOPS = {
+    "sparse_sgd": 2, "sparse_adagrad": 6, "sparse_adam": 12,
+}
+
 
 def _prod(dims: Sequence[int]) -> int:
     p = 1
@@ -333,6 +342,13 @@ def _flops_for(op: ir.OpDesc,
             return None, False, None
         return _OPTIMIZER_FLOPS[t] * p.numel, True, None
 
+    if t in _SPARSE_OPTIMIZER_FLOPS:
+        g = first("Grad")
+        if g is None:
+            return None, False, None
+        return (_SPARSE_OPTIMIZER_FLOPS[t] * g.numel, True,
+                "sparse apply: touched rows only")
+
     if t == "__vjp__":
         fwd_dict = op.attrs.get("fwd_op")
         if not fwd_dict:
@@ -395,6 +411,30 @@ def _bytes_override(op: ir.OpDesc,
                 if v is not None:
                     idx += v.bytes
         return 2 * new_b + idx, "kv cache: updated rows only"
+    if op.type in ("sparse_sgd", "sparse_adagrad", "sparse_adam"):
+        # sparse apply touches the DEDUPED rows only: the generic walk
+        # would charge the full [vocab, dim] param (and each slot) as
+        # read+written, overstating a billion-row table's update
+        # traffic by vocab/touched. Real traffic per touched row:
+        # param read+write + grad read (3x touched) plus a read+write
+        # of every row-wise slot (adagrad: moment; adam: m1+m2), plus
+        # the deduped ids. Scalar beta-pow accumulators are noise.
+        touched = 0
+        names = op.input("Grad")
+        if names:
+            v = lookup(names[0])
+            if v is not None:
+                touched = v.bytes
+        n_slots = {"sparse_sgd": 0, "sparse_adagrad": 1,
+                   "sparse_adam": 2}[op.type]
+        ids = 0
+        names = op.input("Ids")
+        if names:
+            v = lookup(names[0])
+            if v is not None:
+                ids = v.bytes
+        return ((3 + 2 * n_slots) * touched + ids,
+                "sparse apply: touched rows + slots only")
     if op.type == "slice":
         # a slice reads exactly the rows it keeps — the decode step
         # slices the first L rows out of a [slots, h, max_seq, d]

@@ -67,7 +67,16 @@ def save_sharded(dirname: str, names=None, scope=None) -> str:
         if arr is None:
             continue
         entry = {"dtype": None, "shape": None, "pieces": []}
-        if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+        sharded = isinstance(arr, jax.Array) and (
+            not arr.is_fully_addressable
+            or len({_index_key(s.index, arr.shape)
+                    for s in arr.addressable_shards}) > 1)
+        if sharded:
+            # one piece per distinct shard — also on the SINGLE-process
+            # multi-device layout, where the array is fully addressable
+            # but np.asarray(arr) would assemble the dense value on the
+            # host (a sharded embedding table may not fit there; the
+            # round-trip contract is piece-sized host memory)
             entry["shape"] = list(arr.shape)
             entry["dtype"] = str(np.dtype(arr.dtype.name if hasattr(
                 arr.dtype, "name") else arr.dtype))

@@ -1,0 +1,33 @@
+"""paddle_tpu.embedding — billion-row sharded embedding subsystem.
+
+TPU-native rebuild of the reference's distributed sparse parameter
+path (SelectedRows, distributed lookup table, pserver sparse
+optimizer): a production layer over parallel/sparse.sharded_lookup.
+
+- :class:`TableConfig` / :class:`ShardedTable` (table.py): row-sharded
+  param + per-shard optimizer slots, per-shard seeded init — the dense
+  [vocab, dim] value never exists anywhere.
+- sparse_optimizer.py: unique-ids dedup + scatter row updates for
+  sgd/adagrad/adam with row-wise lazy slots, bit-identical to the
+  dense single-chip optimizer on touched rows.
+- :class:`HotRowCache` (hot_cache.py): frequency-elected replicated
+  top-K rows so hot ids never cross the model axis; periodic refresh
+  bounds staleness.
+- checkpoint.py: save/load over distributed/sharded_checkpoint, one
+  piece per shard, never densified.
+- serving.py: ParallelExecutor-backed ServableModel so a
+  distributed=True export serves sharded under the PR 7 lifecycle.
+- metrics.py: the paddle_tpu_embed_* observability families.
+
+Driven end-to-end by models/deepfm.py (DeepFMSharded) and
+benchmarks/embedding_scale.py.
+"""
+from .table import ShardedTable, TableConfig  # noqa: F401
+from .sparse_optimizer import (dedup_ids, dense_reference_apply,  # noqa
+                               masked_gather, segment_sum_rows,
+                               sparse_apply)
+from .hot_cache import (FrequencyTracker, HotRowCache,  # noqa: F401
+                        cached_gather)
+from .checkpoint import load_table, save_table  # noqa: F401
+from .serving import load_sharded_servable  # noqa: F401
+from . import metrics  # noqa: F401

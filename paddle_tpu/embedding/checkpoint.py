@@ -1,0 +1,83 @@
+"""Checkpoint round-trip for ShardedTable — param + optimizer slots,
+never densified.
+
+Rides distributed/sharded_checkpoint: each piece of the row-sharded
+param and each row-slot accumulator is written per shard (one npz blob
+per shard block — the dense [vocab, dim] value exists nowhere, host
+included), scalar slots and the step counter ride in a small JSON
+sidecar together with the TableConfig. Restore rebuilds the table from
+its config (per-shard seeded init) and overwrites state piece-by-piece
+through jax.make_array_from_callback with the table's own sharding —
+the same elastic-resharding fallbacks as the rest of the framework's
+sharded checkpoints apply.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.scope import Scope
+from ..distributed.sharded_checkpoint import load_sharded, save_sharded
+from .sparse_optimizer import ROW_SLOTS, SCALAR_SLOTS
+from .table import ShardedTable, TableConfig
+
+_META = "table_meta.json"
+
+
+def _row_state_names(config: TableConfig):
+    names = [f"{config.name}.param"]
+    names += [f"{config.name}.{s}" for s in ROW_SLOTS[config.optimizer]]
+    return names
+
+
+def save_table(dirname: str, table: ShardedTable) -> str:
+    """Write the table's param + row slots per shard, plus config,
+    scalar slots, and step in a JSON sidecar."""
+    os.makedirs(dirname, exist_ok=True)
+    cfg = table.config
+    scope = Scope()
+    scope.set(f"{cfg.name}.param", table.param)
+    for s in ROW_SLOTS[cfg.optimizer]:
+        scope.set(f"{cfg.name}.{s}", table.slots[s])
+    save_sharded(dirname, _row_state_names(cfg), scope)
+    meta = {"config": cfg.to_dict(), "step": table.step,
+            "scalar_slots": {s: np.asarray(table.slots[s]).tolist()
+                             for s in SCALAR_SLOTS[cfg.optimizer]}}
+    with open(os.path.join(dirname, _META), "w") as f:
+        json.dump(meta, f)
+    return dirname
+
+
+def load_table(dirname: str, mesh=None, hot_cache: bool = False
+               ) -> ShardedTable:
+    """Rebuild a ShardedTable from its checkpoint. State is restored
+    piece-by-piece onto the table's sharding; the dense value is never
+    assembled when the mesh layout matches the save."""
+    with open(os.path.join(dirname, _META)) as f:
+        meta = json.load(f)
+    cfg = TableConfig.from_dict(meta["config"])
+    table = ShardedTable(cfg, mesh=mesh, hot_cache=hot_cache)
+    sh = table._sharding()
+    scope = Scope()
+    names = _row_state_names(cfg)
+    shardings = {n: sh for n in names} if sh is not None else None
+    load_sharded(dirname, shardings=shardings, scope=scope)
+    table.param = _as_device(scope.get(f"{cfg.name}.param"), sh)
+    for s in ROW_SLOTS[cfg.optimizer]:
+        table.slots[s] = _as_device(scope.get(f"{cfg.name}.{s}"), sh)
+    for s, v in meta.get("scalar_slots", {}).items():
+        table.slots[s] = jnp.asarray(np.asarray(v, np.float32))
+    table.step = int(meta["step"])
+    return table
+
+
+def _as_device(val, sharding):
+    if sharding is None:
+        return jnp.asarray(val)
+    import jax
+    if isinstance(val, jax.Array) and val.sharding == sharding:
+        return val
+    return jax.device_put(val, sharding)

@@ -1,0 +1,181 @@
+"""Frequency-aware hot-row caching for sharded embedding tables.
+
+Recommender id streams are zipfian: a few thousand hot rows absorb most
+of the lookup volume (the reference's distributed lookup table design
+doc motivates its pserver-side cache the same way). Here the hot set is
+REPLICATED: a sorted top-K id vector plus their rows live on every
+chip, so a hot id resolves locally — it never crosses the model axis.
+Cold ids still take the sharded masked-gather + psum path.
+
+Mechanics:
+
+- a host-side bounded :class:`FrequencyTracker` (lossy top-K counting;
+  a dense per-row counter would be O(vocab) host memory) observes the
+  raw id stream;
+- every ``PADDLE_TPU_EMBED_CACHE_REFRESH_STEPS`` applies, the top-K
+  rows are re-elected and their CURRENT values re-gathered — the
+  cache's staleness bound;
+- between refreshes, write-through keeps rows updated by THIS worker
+  exact; rows updated by other workers may be up to one refresh
+  interval stale (single-worker: the cache is always exact). See
+  KNOWN_GAPS "Sharded embedding boundaries".
+
+Byte accounting: cache hits alone do not shrink the psum payload —
+that is sized by the gather's static shape. The savings come from
+:func:`cached_gather`'s miss COMPACTION (``miss_budget``): only a
+miss-sized id vector crosses the model axis. Overflow (more misses
+than budget) is reported loudly in the returned stats; callers that
+cannot tolerate a re-run must size the budget for their stream.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import flags
+from . import metrics as embed_metrics
+from .sparse_optimizer import masked_gather
+
+#: cache-slot sentinel: never equals a real id (ids are int32 row
+#: numbers well below this), so empty slots can never hit
+_EMPTY = np.iinfo(np.int32).max
+
+
+class FrequencyTracker:
+    """Bounded lossy id-frequency counter (space-saving flavor): counts
+    live in a dict pruned back to ``capacity`` whenever it doubles, so
+    host memory is O(capacity) however large the vocab. Heavy hitters
+    of a zipfian stream survive pruning by construction."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        self.capacity = int(capacity if capacity is not None
+                            else flags.get(
+                                "PADDLE_TPU_EMBED_FREQ_CAPACITY"))
+        self.counts = {}
+
+    def update(self, ids: np.ndarray):
+        u, c = np.unique(ids, return_counts=True)
+        for i, n in zip(u.tolist(), c.tolist()):
+            self.counts[i] = self.counts.get(i, 0) + n
+        if len(self.counts) > 2 * self.capacity:
+            keep = sorted(self.counts.items(),
+                          key=lambda kv: -kv[1])[:self.capacity]
+            self.counts = dict(keep)
+
+    def top(self, k: int) -> np.ndarray:
+        """The up-to-k hottest ids (unsorted)."""
+        top = sorted(self.counts.items(), key=lambda kv: -kv[1])[:k]
+        return np.asarray([i for i, _ in top], np.int32)
+
+
+def cached_gather(param, cache_ids, cache_rows, uniq, valid,
+                  mesh=None, axis: str = "model", sentinel: int = None,
+                  miss_budget: Optional[int] = None):
+    """Resolve unique ids against the replicated cache, gathering only
+    the misses from the sharded table.
+
+    Returns ``(rows, n_hits, n_misses, overflow)`` (the counts/flag as
+    0-d arrays). ``miss_budget=None`` gathers a full-size id vector
+    (misses routed through it, hits as sentinels — correct for any
+    stream, no byte savings); an integer budget compacts the misses to
+    that static size, shrinking the psum payload to budget x dim.
+    Misses beyond the budget come back as ZERO rows and ``overflow``
+    is set — callers must check it (the benchmark sizes the budget
+    from the observed hit ratio).
+    """
+    sentinel = param.shape[0] if sentinel is None else sentinel
+    k = cache_ids.shape[0]
+    pos = jnp.searchsorted(cache_ids, uniq)
+    posc = jnp.clip(pos, 0, k - 1)
+    hit = (jnp.take(cache_ids, posc) == uniq) & valid
+    cached = jnp.take(cache_rows, posc, axis=0)
+    if miss_budget is None:
+        cold_ids = jnp.where(hit, sentinel, uniq)
+        cold = masked_gather(param, cold_ids, mesh, axis)
+        overflow = jnp.zeros((), bool)
+    else:
+        u = uniq.shape[0]
+        miss = valid & ~hit
+        (midx,) = jnp.nonzero(miss, size=int(miss_budget),
+                              fill_value=u)
+        safe = jnp.clip(midx, 0, u - 1)
+        miss_ids = jnp.where(midx < u, jnp.take(uniq, safe), sentinel)
+        cold_small = masked_gather(param, miss_ids, mesh, axis)
+        tgt = jnp.where(midx < u, midx, u)
+        cold = jnp.zeros((u, param.shape[1]), param.dtype) \
+            .at[tgt].set(cold_small, mode="drop")
+        overflow = jnp.sum(miss) > miss_budget
+    rows = jnp.where(hit[:, None], cached, cold)
+    return rows, jnp.sum(hit), jnp.sum(valid & ~hit), overflow
+
+
+class HotRowCache:
+    """Replicated top-K hot rows of one table (see module docstring)."""
+
+    def __init__(self, table_name: str, dim: int, dtype: str,
+                 capacity: Optional[int] = None,
+                 refresh_interval: Optional[int] = None,
+                 tracker_capacity: Optional[int] = None):
+        self.table_name = table_name
+        self.capacity = int(capacity if capacity is not None
+                            else flags.get(
+                                "PADDLE_TPU_EMBED_HOT_CACHE_ROWS"))
+        self.refresh_interval = int(
+            refresh_interval if refresh_interval is not None
+            else flags.get("PADDLE_TPU_EMBED_CACHE_REFRESH_STEPS"))
+        self.tracker = FrequencyTracker(tracker_capacity)
+        # sorted ids (all-empty sorts trivially); searchsorted is the
+        # hit test
+        self.ids = jnp.full((self.capacity,), _EMPTY, jnp.int32)
+        self.rows = jnp.zeros((self.capacity, dim), dtype)
+        self.last_refresh = 0
+        self.refreshes = 0
+
+    def observe(self, ids_np: np.ndarray,
+                padding_idx: Optional[int] = None):
+        ids_np = np.asarray(ids_np).reshape(-1)
+        if padding_idx is not None:
+            ids_np = ids_np[ids_np != padding_idx]
+        if ids_np.size:
+            self.tracker.update(ids_np)
+
+    def lookup(self, table, uniq, valid):
+        """(rows, hits, misses) over the unique-id vector; full-size
+        cold gather (no compaction — the training path must be correct
+        for any stream)."""
+        rows, h, m, _ovf = cached_gather(
+            table.param, self.ids, self.rows, uniq, valid,
+            table.mesh, table.config.axis, table.sentinel)
+        return rows, int(np.asarray(h)), int(np.asarray(m))
+
+    def write_through(self, uniq, valid, new_rows):
+        k = self.capacity
+        pos = jnp.searchsorted(self.ids, uniq)
+        posc = jnp.clip(pos, 0, k - 1)
+        hit = (jnp.take(self.ids, posc) == uniq) & valid
+        tgt = jnp.where(hit, posc, k)
+        self.rows = self.rows.at[tgt].set(new_rows, mode="drop")
+
+    def refresh(self, table):
+        """Re-elect the top-K rows and re-gather their current values
+        (the staleness reset)."""
+        top = self.tracker.top(self.capacity)
+        ids = np.full((self.capacity,), _EMPTY, np.int32)
+        ids[:top.size] = np.sort(top)
+        self.ids = jnp.asarray(ids)
+        safe = jnp.where(self.ids == _EMPTY, table.sentinel, self.ids)
+        self.rows = masked_gather(table.param, safe, table.mesh,
+                                  table.config.axis)
+        self.last_refresh = table.step
+        self.refreshes += 1
+        embed_metrics.record_refresh(self.table_name)
+        embed_metrics.record_staleness(self.table_name, 0)
+
+    def maybe_refresh(self, table, step: int):
+        embed_metrics.record_staleness(self.table_name,
+                                       step - self.last_refresh)
+        if self.tracker.counts and \
+                step - self.last_refresh >= self.refresh_interval:
+            self.refresh(table)

@@ -1,0 +1,99 @@
+"""paddle_tpu_embed_* metric families — observability for the sharded
+embedding subsystem.
+
+One family per question an embedding-serving oncall actually asks
+(mirroring the reference pserver's sparse-table stats surface,
+ParameterServer2 stat sets): how many lookups/ids, what fraction of
+ids the replicated hot cache absorbed (the model-axis traffic saver),
+how many rows each optimizer apply actually touched (the SelectedRows
+"only touched rows" number the cost model prices), and how stale the
+hot cache is allowed to get between refreshes.
+
+All families live in the process-wide registry
+(observability/registry.py) under the enforced ``paddle_tpu_*``
+namespace; tests/test_metric_names.py asserts every one of them is
+published by the smoke run and carries help text.
+"""
+from __future__ import annotations
+
+from ..observability import default_registry
+
+_LABELS = ("table",)
+
+
+def families():
+    """Create-or-get every embed family. Idempotent: the registry
+    returns the existing family when the declaration matches."""
+    reg = default_registry()
+    return {
+        "lookups": reg.counter(
+            "paddle_tpu_embed_lookups_total",
+            "sharded-table lookup calls (one per batch gather)",
+            _LABELS),
+        "ids": reg.counter(
+            "paddle_tpu_embed_ids_total",
+            "ids presented to sharded-table lookups (pre-dedup, "
+            "padding ids excluded)", _LABELS),
+        "hits": reg.counter(
+            "paddle_tpu_embed_hot_cache_hits_total",
+            "unique ids resolved from the replicated hot-row cache "
+            "(no model-axis crossing)", _LABELS),
+        "misses": reg.counter(
+            "paddle_tpu_embed_hot_cache_misses_total",
+            "unique ids that took the cold sharded-gather path",
+            _LABELS),
+        "hit_ratio": reg.gauge(
+            "paddle_tpu_embed_hot_cache_hit_ratio",
+            "hot-cache hit ratio over unique ids, most recent lookup",
+            _LABELS),
+        "touched_rows": reg.gauge(
+            "paddle_tpu_embed_touched_rows",
+            "unique non-padding rows updated by the most recent "
+            "sparse optimizer apply (the SelectedRows touched-row "
+            "count the cost model prices)", _LABELS),
+        "applies": reg.counter(
+            "paddle_tpu_embed_applies_total",
+            "sparse optimizer applies against the sharded table",
+            ("table", "optimizer")),
+        "refreshes": reg.counter(
+            "paddle_tpu_embed_cache_refreshes_total",
+            "hot-cache refreshes (frequency tracker re-elected the "
+            "top-K rows and their values were re-gathered)", _LABELS),
+        "staleness": reg.gauge(
+            "paddle_tpu_embed_cache_staleness_steps",
+            "applies since the hot cache was last refreshed (its "
+            "staleness bound; write-through keeps rows touched by "
+            "THIS worker current in between)", _LABELS),
+        "rows": reg.gauge(
+            "paddle_tpu_embed_table_rows",
+            "vocab rows of the sharded table (pre-padding)", _LABELS),
+    }
+
+
+def record_lookup(table: str, n_ids: int, hits: int, misses: int):
+    fams = families()
+    fams["lookups"].labels(table=table).inc()
+    fams["ids"].labels(table=table).inc(n_ids)
+    if hits or misses:
+        fams["hits"].labels(table=table).inc(hits)
+        fams["misses"].labels(table=table).inc(misses)
+        fams["hit_ratio"].labels(table=table).set(
+            hits / float(hits + misses))
+
+
+def record_apply(table: str, optimizer: str, touched: int):
+    fams = families()
+    fams["applies"].labels(table=table, optimizer=optimizer).inc()
+    fams["touched_rows"].labels(table=table).set(touched)
+
+
+def record_refresh(table: str):
+    families()["refreshes"].labels(table=table).inc()
+
+
+def record_staleness(table: str, steps: int):
+    families()["staleness"].labels(table=table).set(steps)
+
+
+def record_table(table: str, vocab: int):
+    families()["rows"].labels(table=table).set(vocab)

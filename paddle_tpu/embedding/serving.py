@@ -1,0 +1,66 @@
+"""Serving a model whose embedding tables are row-sharded.
+
+A `save_inference_model` export of a `distributed=True` model (e.g.
+models/deepfm.py) keeps `is_distributed` on its lookup_table ops, so
+the frozen program still routes through parallel/sparse.sharded_lookup
+— IF the executor carries a mesh. The plain Executor a ServableModel
+builds does not; :func:`load_sharded_servable` injects a
+ParallelExecutor (plus its run lock) and re-places each table onto its
+row-sharded layout in the servable's private scope, exactly the moment
+the reference would hand tables to the pserver-backed lookup at serve
+time. The returned ServableModel drops into the PR 7 lifecycle
+unchanged (`ModelHost(model=...)` accepts a prebuilt servable), so
+hot-swap/canary/admission all apply to sharded-table serving.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.executor import ParallelExecutor, ShardingSpec
+from ..parallel.mesh import get_mesh, make_mesh
+from ..serving.model import ServableModel
+
+
+def _table_param_names(program, scope) -> Sequence[str]:
+    """Tables of the frozen program: inputs of is_distributed
+    lookup_table ops that are present in the loaded scope."""
+    names = []
+    desc = program.desc if hasattr(program, "desc") else program
+    for block in desc.blocks:
+        for op in block.ops:
+            if op.type == "lookup_table" and \
+                    op.attrs.get("is_distributed"):
+                for w in op.input("W"):
+                    if scope.find(w) is not None and w not in names:
+                        names.append(w)
+    return names
+
+
+def load_sharded_servable(dirname: str, mesh=None, axis: str = "model",
+                          table_names: Optional[Sequence[str]] = None,
+                          **load_kw) -> ServableModel:
+    """Load a save_inference_model export whose embedding tables should
+    serve row-sharded over ``axis``. Default mesh: the active one, or
+    an inference mesh (1, n_devices) over ('data', 'model') — batch
+    replicated, tables sharded."""
+    mesh = mesh if mesh is not None else get_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        n = len(jax.devices())
+        mesh = make_mesh((1, n), ("data", axis))
+    run_lock = threading.Lock()
+    exe = ParallelExecutor(
+        mesh=mesh, sharding=ShardingSpec(specs={}, feed_axis="data"))
+    model = ServableModel.load(dirname, executor=exe,
+                               run_lock=run_lock, **load_kw)
+    names = (list(table_names) if table_names is not None
+             else _table_param_names(model.program, model.scope))
+    sharding = NamedSharding(mesh, P(axis, None))
+    for name in names:
+        val = model.scope.get(name)
+        model.scope.set(name, jax.device_put(val, sharding))
+        exe.sharding.specs[name] = P(axis, None)
+    return model

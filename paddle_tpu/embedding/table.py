@@ -1,0 +1,239 @@
+"""ShardedTable: a row-sharded embedding table whose parameter AND
+optimizer slot state are created, updated, and checkpointed PER SHARD.
+
+The bigger-than-HBM contract: no code path ever materializes the dense
+``[vocab, dim]`` array on a single host or device —
+
+- init is per-shard seeded (``jax.make_array_from_callback``: each
+  addressable shard's rows are generated from a counter-based seed
+  keyed by ``(seed, row_start)``, so a host only ever holds one
+  shard-sized block);
+- lookups ride ``sparse_optimizer.masked_gather`` (each shard answers
+  its own row range, psum assembles — model-axis bytes scale with
+  TOUCHED rows, never vocab);
+- the sparse optimizer apply gathers/updates/scatters only the touched
+  rows of each shard locally (no collective at all);
+- checkpointing (embedding/checkpoint.py over
+  distributed/sharded_checkpoint) writes one piece per shard.
+
+On one chip (mesh=None) everything degrades to the real dense-math
+single-chip path at full fidelity; >1-chip layouts at 1e8–1e9 vocab are
+exercised in dryrun (compile + collective audit, no data) — see
+KNOWN_GAPS "Sharded embedding boundaries".
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import get_mesh
+from . import metrics as embed_metrics
+from .sparse_optimizer import (ROW_SLOTS, SCALAR_SLOTS, dedup_ids,
+                               masked_gather, segment_sum_rows,
+                               sparse_apply)
+
+
+class TableConfig:
+    """Static description of one sharded table — everything needed to
+    rebuild it (init included) without its data, so checkpoints and
+    dryrun layouts carry the config, not the rows."""
+
+    def __init__(self, name: str, vocab: int, dim: int,
+                 dtype: str = "float32", optimizer: str = "sgd",
+                 lr: float = 0.01, hyper: Optional[Dict[str, float]]
+                 = None, init_scale: float = 0.01, seed: int = 0,
+                 axis: str = "model", padding_idx: Optional[int] = None):
+        if optimizer not in ROW_SLOTS:
+            raise ValueError(
+                f"table {name!r}: no sparse rule for {optimizer!r}; "
+                f"have {sorted(ROW_SLOTS)}")
+        self.name = name
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+        self.dtype = dtype
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.hyper = dict(hyper or {})
+        self.init_scale = float(init_scale)
+        self.seed = int(seed)
+        self.axis = axis
+        self.padding_idx = padding_idx
+
+    def to_dict(self) -> Dict:
+        return {k: getattr(self, k) for k in
+                ("name", "vocab", "dim", "dtype", "optimizer", "lr",
+                 "hyper", "init_scale", "seed", "axis", "padding_idx")}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "TableConfig":
+        return cls(**d)
+
+    def init_rows(self, row_start: int, n_rows: int) -> np.ndarray:
+        """Seeded init for one row block — the per-shard init callback.
+        Deterministic in ``(seed, row_start)`` only, so a host
+        materializes exactly its own block; rows past ``vocab`` (the
+        shard-alignment padding) are zero."""
+        rng = np.random.default_rng([self.seed, int(row_start)])
+        block = (self.init_scale *
+                 rng.standard_normal((int(n_rows), self.dim))) \
+            .astype(self.dtype)
+        first_pad = max(0, min(int(n_rows),
+                               self.vocab - int(row_start)))
+        block[first_pad:] = 0
+        return block
+
+
+class ShardedTable:
+    """Row-sharded embedding table + its per-shard optimizer state.
+
+    ``mesh=None`` (or a mesh without the table's axis… is an error; no
+    silent dense fallback at scale) runs the single-chip dense-layout
+    path with identical math. ``hot_cache=True`` attaches a replicated
+    top-K hot-row cache (embedding/hot_cache.py) sized by the
+    embed flags (see flags.py).
+    """
+
+    def __init__(self, config: TableConfig, mesh=None,
+                 hot_cache: bool = False):
+        self.config = config
+        self.mesh = mesh if mesh is not None else get_mesh()
+        if self.mesh is not None and \
+                config.axis not in self.mesh.axis_names:
+            raise ValueError(
+                f"table {config.name!r}: shard axis {config.axis!r} is "
+                f"not an axis of the mesh {self.mesh.axis_names}")
+        self.n_shards = (1 if self.mesh is None
+                         else self.mesh.shape[config.axis])
+        self.padded_vocab = (-(-config.vocab // self.n_shards)
+                             * self.n_shards)
+        #: sentinel id: strictly out of bounds on every path (dedup
+        #: fill, padding rows, hot-cache hits all route here)
+        self.sentinel = self.padded_vocab
+        self.step = 0
+        self.param = self._rowwise_array(self.config.init_rows)
+        self.slots: Dict[str, jax.Array] = {}
+        for slot in ROW_SLOTS[config.optimizer]:
+            self.slots[slot] = self._rowwise_array(
+                lambda start, n: np.zeros((n, config.dim),
+                                          config.dtype))
+        hyper = dict(config.hyper)
+        if config.optimizer == "adam":
+            self.slots["beta1_pow"] = jnp.full(
+                (1,), hyper.get("beta1", 0.9), jnp.float32)
+            self.slots["beta2_pow"] = jnp.full(
+                (1,), hyper.get("beta2", 0.999), jnp.float32)
+        self.hot_cache = None
+        if hot_cache:
+            from .hot_cache import HotRowCache
+            self.hot_cache = HotRowCache(config.name, config.dim,
+                                         config.dtype)
+        embed_metrics.record_table(config.name, config.vocab)
+
+    # -- state ----------------------------------------------------------
+    def _sharding(self):
+        return (None if self.mesh is None else
+                NamedSharding(self.mesh, P(self.config.axis, None)))
+
+    def _rowwise_array(self, row_fn) -> jax.Array:
+        """Build a [padded_vocab, dim] array one shard block at a time
+        — the dense array never exists on any host."""
+        shape = (self.padded_vocab, self.config.dim)
+        sh = self._sharding()
+        if sh is None:
+            return jnp.asarray(row_fn(0, self.padded_vocab))
+
+        def cb(index):
+            rs = index[0]
+            start = 0 if rs.start is None else int(rs.start)
+            stop = shape[0] if rs.stop is None else int(rs.stop)
+            return row_fn(start, stop - start)
+
+        return jax.make_array_from_callback(shape, sh, cb)
+
+    def state(self):
+        """(param, slots) — the functional state for jitted loops and
+        checkpointing; write back with :meth:`set_state`."""
+        return self.param, dict(self.slots)
+
+    def set_state(self, param, slots):
+        self.param = param
+        self.slots = dict(slots)
+
+    # -- lookup ---------------------------------------------------------
+    def dedup(self, ids):
+        """(uniq, inv, valid) — unique touched rows at static size,
+        padding ids routed to the sentinel (never touched, never
+        counted)."""
+        uniq, inv, valid = dedup_ids(jnp.asarray(ids),
+                                     self.config.vocab,
+                                     self.config.padding_idx)
+        return uniq, inv, valid
+
+    def lookup_unique(self, ids):
+        """Dedup + gather: returns ``(rows, uniq, inv, valid)`` with
+        ``rows[inv]`` the embedding output (zeros at padding
+        positions). Hot-cache hits resolve from the replicated cache;
+        misses (or everything, without a cache) take the sharded
+        gather."""
+        ids = jnp.asarray(ids)
+        uniq, inv, valid = self.dedup(ids)
+        if self.hot_cache is not None:
+            rows, hits, misses = self.hot_cache.lookup(self, uniq,
+                                                       valid)
+            self.hot_cache.observe(np.asarray(ids).reshape(-1),
+                                   self.config.padding_idx)
+        else:
+            rows = masked_gather(self.param, uniq, self.mesh,
+                                 self.config.axis)
+            hits, misses = 0, int(np.asarray(jnp.sum(valid)))
+        n_ids = np.asarray(ids).reshape(-1)
+        if self.config.padding_idx is not None:
+            n_ids = n_ids[n_ids != self.config.padding_idx]
+        embed_metrics.record_lookup(self.config.name, int(n_ids.size),
+                                    hits, misses)
+        return rows, uniq, inv, valid
+
+    def lookup(self, ids):
+        """Embedding forward: [*, dim] rows for an id batch (dense
+        clip semantics for OOB ids; zeros at padding positions)."""
+        rows, _uniq, inv, _valid = self.lookup_unique(ids)
+        return jnp.take(rows, inv, axis=0)
+
+    # -- sparse apply ---------------------------------------------------
+    def apply_rows(self, uniq, valid, grad_rows):
+        """One sparse optimizer step from deduped row gradients (the
+        autodiff cotangent of ``rows`` in :meth:`lookup_unique` is
+        already occurrence-accumulated). Only valid rows are touched —
+        param and slots of every other row are bit-unchanged."""
+        self.param, self.slots = sparse_apply(
+            self.config.optimizer, self.param, self.slots, uniq,
+            grad_rows, valid, self.config.lr, self.config.hyper,
+            self.mesh, self.config.axis)
+        self.step += 1
+        touched = int(np.asarray(jnp.sum(valid)))
+        embed_metrics.record_apply(self.config.name,
+                                   self.config.optimizer, touched)
+        if self.hot_cache is not None:
+            # write-through: rows THIS worker just updated stay exact
+            # in the cache between refreshes (one extra touched-rows
+            # gather, only when a cache is attached)
+            new_rows = masked_gather(
+                self.param, jnp.where(valid, uniq, self.sentinel),
+                self.mesh, self.config.axis)
+            self.hot_cache.write_through(uniq, valid, new_rows)
+            self.hot_cache.maybe_refresh(self, self.step)
+        return touched
+
+    def apply_gradients(self, ids, occurrence_grads):
+        """SelectedRows entry point: per-occurrence row gradients
+        (shaped ``ids.shape + (dim,)``) are deduped (segment-sum) and
+        applied to the touched rows."""
+        ids = jnp.asarray(ids)
+        uniq, inv, valid = self.dedup(ids)
+        grad_rows = segment_sum_rows(jnp.asarray(occurrence_grads),
+                                     inv, uniq.shape[0])
+        return self.apply_rows(uniq, valid, grad_rows)

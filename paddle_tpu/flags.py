@@ -189,6 +189,24 @@ FLAGS: Dict[str, tuple] = {
         "default per-model admission budget of a GenerationHost: max "
         "concurrently admitted (queued + in-flight) requests per "
         "hosted model before sheds with reason=model_budget"),
+    "PADDLE_TPU_EMBED_HOT_CACHE_ROWS": (
+        "1024", "embedding/hot_cache.py (via embedding/table.py)",
+        "default row capacity of a ShardedTable's replicated hot-row "
+        "cache (top-K by observed frequency); 0 disables the cache so "
+        "every id takes the cold sharded-gather path"),
+    "PADDLE_TPU_EMBED_CACHE_REFRESH_STEPS": (
+        "50", "embedding/hot_cache.py",
+        "steps between hot-cache refreshes: the host-side frequency "
+        "tracker re-elects the top-K rows and re-gathers their current "
+        "values; also the cache's staleness bound — between refreshes "
+        "only write-through updates (rows this worker touched) land "
+        "in the cache"),
+    "PADDLE_TPU_EMBED_FREQ_CAPACITY": (
+        "8192", "embedding/hot_cache.py",
+        "bounded id-frequency tracker capacity (lossy top-K counting "
+        "— a dense per-row counter would be O(vocab) host memory, "
+        "unpayable at 1e9 rows); pruned back to this size whenever it "
+        "doubles"),
     "PADDLE_TPU_BN_CUSTOM_VJP": (
         "0", "ops/nn_ops.py",
         "use the round-2 hand-written BatchNorm backward (custom_vjp) "

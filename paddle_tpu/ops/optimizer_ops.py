@@ -18,6 +18,118 @@ def _no_grads(*slots):
     return list(slots)
 
 
+# ---------------------------------------------------------------------------
+# sparse (row-wise lazy) update rules — the SelectedRows path
+# ---------------------------------------------------------------------------
+#: hyperparameter defaults per sparse rule, matching the dense ops above
+SPARSE_HYPER_DEFAULTS = {
+    "sgd": {},
+    "adagrad": {"epsilon": 1e-6},
+    "adam": {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+}
+
+
+def sparse_row_update(kind, p_rows, slot_rows, g, lr, hyper,
+                      b1p=None, b2p=None):
+    """One optimizer step restricted to the TOUCHED rows — formulas are
+    the exact expressions of the dense ops above (`_sgd`, `_adagrad`,
+    `_adam`), applied to gathered row blocks so the sparse path is
+    bit-identical to the dense single-chip optimizer on those rows.
+    ``slot_rows`` is a tuple of gathered accumulator row blocks in the
+    order the dense op reads them; returns (new_p_rows, new_slot_rows).
+
+    Lazy semantics (reference SelectedRows / sparse adam): rows NOT in
+    the update never decay — for adam that means a row touched only
+    intermittently diverges from the dense rule, which decays moments
+    every step (documented in KNOWN_GAPS "Sharded embedding
+    boundaries"). Rows touched every step match bitwise.
+    """
+    lr = lr.reshape(()).astype(p_rows.dtype)
+    if kind == "sgd":
+        return p_rows - lr * g, ()
+    if kind == "adagrad":
+        (m,) = slot_rows
+        eps = hyper.get("epsilon", 1e-6)
+        m_out = m + jnp.square(g)
+        return p_rows - lr * g / (jnp.sqrt(m_out) + eps), (m_out,)
+    if kind == "adam":
+        m1, m2 = slot_rows
+        b1 = hyper.get("beta1", 0.9)
+        b2 = hyper.get("beta2", 0.999)
+        eps = hyper.get("epsilon", 1e-8)
+        b1p = b1p.reshape(())
+        b2p = b2p.reshape(())
+        m1_out = b1 * m1 + (1 - b1) * g
+        m2_out = b2 * m2 + (1 - b2) * jnp.square(g)
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        p_out = p_rows - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
+        return p_out, (m1_out, m2_out)
+    raise ValueError(f"no sparse update rule for optimizer {kind!r}; "
+                     f"have {sorted(SPARSE_HYPER_DEFAULTS)}")
+
+
+def _sparse_scatter(ctx, kind, slot_in_out):
+    """Shared body of the sparse_* ops: gather the touched rows of
+    Param (+ slots), run `sparse_row_update`, scatter the results back.
+    Ids outside [0, vocab) — negative, the dedup fill sentinel, a
+    padding row routed to the sentinel — are DROPPED: their rows (and
+    slot rows: lazy semantics) are left untouched."""
+    p = ctx.input("Param")
+    g = ctx.input("Grad")              # [U, D] deduped row gradients
+    ids = ctx.input("Ids")             # [U] unique row ids
+    lr = ctx.input("LearningRate")
+    vocab = p.shape[0]
+    hit = (ids >= 0) & (ids < vocab)
+    safe = jnp.clip(ids, 0, vocab - 1)
+    p_rows = jnp.take(p, safe, axis=0)
+    slot_rows = tuple(jnp.take(ctx.input(s), safe, axis=0)
+                      for s, _o in slot_in_out)
+    hyper = {k: ctx.attr(k, v)
+             for k, v in SPARSE_HYPER_DEFAULTS[kind].items()}
+    b1p = ctx.input("Beta1Pow") if kind == "adam" else None
+    b2p = ctx.input("Beta2Pow") if kind == "adam" else None
+    new_p, new_slots = sparse_row_update(kind, p_rows, slot_rows, g, lr,
+                                         hyper, b1p, b2p)
+    tgt = jnp.where(hit, ids, vocab)   # out-of-bounds target -> dropped
+    ctx.set_output("ParamOut", p.at[tgt].set(new_p, mode="drop"))
+    for (s_in, s_out), ns in zip(slot_in_out, new_slots):
+        ctx.set_output(s_out,
+                       ctx.input(s_in).at[tgt].set(ns, mode="drop"))
+    if kind == "adam":
+        b1 = ctx.attr("beta1", 0.9)
+        b2 = ctx.attr("beta2", 0.999)
+        ctx.set_output("Beta1PowOut", ctx.input("Beta1Pow") * b1)
+        ctx.set_output("Beta2PowOut", ctx.input("Beta2Pow") * b2)
+
+
+@register_op("sparse_sgd", no_grad_slots=["Param", "Grad", "Ids",
+                                          "LearningRate"])
+def _sparse_sgd(ctx):
+    """Row-wise SGD over unique touched rows (reference: sgd_op.h
+    SelectedRows branch)."""
+    _sparse_scatter(ctx, "sgd", ())
+
+
+@register_op("sparse_adagrad", no_grad_slots=["Param", "Grad", "Ids",
+                                              "Moment", "LearningRate"])
+def _sparse_adagrad(ctx):
+    """Row-wise Adagrad: touched rows' moment accumulates, untouched
+    rows' moment is untouched (reference: adagrad_op.cc SelectedRows
+    branch)."""
+    _sparse_scatter(ctx, "adagrad", (("Moment", "MomentOut"),))
+
+
+@register_op("sparse_adam", no_grad_slots=[
+    "Param", "Grad", "Ids", "Moment1", "Moment2", "LearningRate",
+    "Beta1Pow", "Beta2Pow"])
+def _sparse_adam(ctx):
+    """Row-wise lazy Adam (reference: adam_op.h SelectedRows branch,
+    lazy_mode): moments decay only on touched rows; the beta powers
+    advance globally once per step."""
+    _sparse_scatter(ctx, "adam", (("Moment1", "Moment1Out"),
+                                  ("Moment2", "Moment2Out")))
+
+
 @register_op("sgd", no_grad_slots=["Param", "Grad", "LearningRate"])
 def _sgd(ctx):
     p = ctx.input("Param")

@@ -1,0 +1,493 @@
+"""paddle_tpu.embedding (ISSUE 19): the billion-row sharded embedding
+subsystem on the 8-virtual-device CPU mesh.
+
+The contracts under test:
+
+- ShardedTable lookups reproduce the dense single-chip path exactly
+  (clip semantics for OOB ids, zeros at padding positions) while the
+  param + optimizer slots live per shard;
+- the sparse optimizer apply is BIT-identical to the dense optimizer
+  on touched rows, for sgd/adagrad/adam, over chained steps — param,
+  row slots, and scalar slots alike — and bit-leaves untouched rows;
+- a padding row never receives gradient (dense IR path) and is never a
+  touched row (sparse path);
+- the hot-row cache serves exact values (write-through + refresh) and
+  absorbs the head of a zipfian stream;
+- checkpoints round-trip per shard — the dense [vocab, dim] array is
+  never written — and a crash/restore mid-epoch resumes to bitwise the
+  same final state as the uninterrupted run;
+- the cost model prices the sparse path by touched rows (hand counts);
+- a distributed=True export serves row-sharded through the PR 7
+  serving lifecycle with predictions matching the dense executor.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.embedding import (ShardedTable, TableConfig,
+                                  cached_gather, dense_reference_apply,
+                                  load_table, masked_gather, save_table)
+from paddle_tpu.parallel import make_mesh
+
+import jax.numpy as jnp
+
+
+def _mesh():
+    return make_mesh((8,), ("model",))
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+# ---------------------------------------------------------------------------
+# flags
+# ---------------------------------------------------------------------------
+def test_embed_flags_registered():
+    from paddle_tpu import flags
+    for name, default in (("PADDLE_TPU_EMBED_HOT_CACHE_ROWS", "1024"),
+                          ("PADDLE_TPU_EMBED_CACHE_REFRESH_STEPS", "50"),
+                          ("PADDLE_TPU_EMBED_FREQ_CAPACITY", "8192")):
+        assert name in flags.FLAGS, name
+        assert flags.FLAGS[name][0] == default
+        assert int(flags.get(name)) == int(default)
+
+
+# ---------------------------------------------------------------------------
+# lookup parity with the dense path
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_sharded_lookup_matches_dense(use_mesh):
+    vocab, dim = 100, 6
+    cfg = TableConfig("t_lookup", vocab, dim, seed=3, padding_idx=7)
+    table = ShardedTable(cfg, mesh=_mesh() if use_mesh else None)
+    # ids include the padding id, duplicates, and OOB values (negative
+    # and past vocab) — the dense lookup_table clips OOB and zeroes
+    # padding positions
+    ids = np.array([[0, 7, 99, -2], [150, 3, 3, 7]], np.int64)
+    out = np.asarray(table.lookup(ids))
+    dense = np.zeros((vocab, dim), np.float32)
+    # assemble the dense reference from the table's own per-shard init
+    for s in range(table.n_shards):
+        lo = s * (table.padded_vocab // table.n_shards)
+        hi = min(vocab, lo + table.padded_vocab // table.n_shards)
+        dense[lo:hi] = cfg.init_rows(lo, hi - lo)[:hi - lo]
+    ref = dense[np.clip(ids, 0, vocab - 1)]
+    ref[ids == 7] = 0.0
+    np.testing.assert_array_equal(out, ref.astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# sparse apply: bit-identical to the dense optimizer on touched rows
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("kind", ["sgd", "adagrad", "adam"])
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_sparse_apply_bit_identical_to_dense(kind, use_mesh):
+    vocab, dim, n_ids = 96, 4, 16
+    r = _rng(11)
+    cfg = TableConfig(f"t_{kind}_{use_mesh}", vocab, dim,
+                      optimizer=kind, lr=0.05, seed=5)
+    table = ShardedTable(cfg, mesh=_mesh() if use_mesh else None)
+    init = np.asarray(table.param)[:vocab].copy()
+
+    dense_p = jnp.asarray(init)
+    dense_slots = {s: jnp.zeros_like(dense_p)
+                   for s in ("moment",) if kind == "adagrad"}
+    if kind == "adam":
+        dense_slots = {"moment1": jnp.zeros_like(dense_p),
+                       "moment2": jnp.zeros_like(dense_p),
+                       "beta1_pow": jnp.full((1,), 0.9, jnp.float32),
+                       "beta2_pow": jnp.full((1,), 0.999, jnp.float32)}
+
+    # the SAME id multiset every step: adam's lazy row semantics only
+    # match the dense rule on rows touched every step (KNOWN_GAPS)
+    ids = r.integers(0, vocab, size=n_ids)
+    touched = np.unique(ids)
+    for step in range(3):
+        grads = r.standard_normal((n_ids, dim)).astype(np.float32)
+        table.apply_gradients(ids, grads)
+        dense_g = jnp.zeros((vocab, dim), jnp.float32) \
+            .at[ids].add(grads)
+        dense_p, dense_slots = dense_reference_apply(
+            kind, dense_p, dense_slots, dense_g, cfg.lr)
+
+    got_p = np.asarray(table.param)[:vocab]
+    ref_p = np.asarray(dense_p)
+    # touched rows: bitwise equal param AND slot state
+    assert np.array_equal(got_p[touched], ref_p[touched])
+    for s in ("moment",) if kind == "adagrad" else ():
+        assert np.array_equal(
+            np.asarray(table.slots[s])[:vocab][touched],
+            np.asarray(dense_slots[s])[touched])
+    if kind == "adam":
+        for s in ("moment1", "moment2"):
+            assert np.array_equal(
+                np.asarray(table.slots[s])[:vocab][touched],
+                np.asarray(dense_slots[s])[touched])
+        for s in ("beta1_pow", "beta2_pow"):
+            assert np.array_equal(np.asarray(table.slots[s]),
+                                  np.asarray(dense_slots[s]))
+    # untouched rows: bitwise the init (lazy semantics)
+    untouched = np.setdiff1d(np.arange(vocab), touched)
+    assert np.array_equal(got_p[untouched], init[untouched])
+
+
+# ---------------------------------------------------------------------------
+# padding_idx: zero gradient, never a touched row
+# ---------------------------------------------------------------------------
+def test_padding_idx_zero_gradient_dense_ir():
+    """layers.embedding(padding_idx=...): the padding row's gradient
+    must be exactly zero — a leak here would train the pad token."""
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ids = layers.data("ids", [4, 1], dtype="int64")
+        emb = layers.embedding(ids, size=[10, 3], padding_idx=2)
+        loss = layers.mean(emb)
+        pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    w_name = main.all_parameters()[0].name
+    exe = pt.Executor()
+    exe.run(startup)
+    before = np.asarray(pt.global_scope().get(w_name)).copy()
+    feed = {"ids": np.array([[[2], [2], [1], [2]],
+                             [[0], [2], [1], [2]]], np.int64)}
+    exe.run(main, feed=feed, fetch_list=[loss])
+    (grad,) = exe.run(main, feed=feed,
+                      fetch_list=[w_name + "@GRAD"])
+    grad = np.asarray(grad)
+    assert np.array_equal(grad[2], np.zeros(3, np.float32)), \
+        f"padding row leaked gradient: {grad[2]}"
+    # rows 0 and 1 DID receive gradient (the mask is row-targeted)
+    assert np.abs(grad[[0, 1]]).sum() > 0
+    after = np.asarray(pt.global_scope().get(w_name))
+    assert np.array_equal(after[2], before[2] - 0.1 * grad[2])
+
+
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_padding_idx_never_touched_sparse(use_mesh):
+    vocab, dim, pad = 40, 3, 5
+    cfg = TableConfig("t_pad", vocab, dim, optimizer="adagrad", lr=0.1,
+                      seed=2, padding_idx=pad)
+    table = ShardedTable(cfg, mesh=_mesh() if use_mesh else None)
+    p0 = np.asarray(table.param)[pad].copy()
+    m0 = np.asarray(table.slots["moment"])[pad].copy()
+    ids = np.array([pad, 1, pad, 9, 1, pad], np.int64)
+    grads = _rng(4).standard_normal((6, dim)).astype(np.float32)
+    touched = table.apply_gradients(ids, grads)
+    # the padding row is not in the touched count and its param AND
+    # slot rows are bit-unchanged
+    assert touched == 2
+    assert np.array_equal(np.asarray(table.param)[pad], p0)
+    assert np.array_equal(np.asarray(table.slots["moment"])[pad], m0)
+    # forward: padding positions come back as zero rows
+    out = np.asarray(table.lookup(ids))
+    assert np.array_equal(out[ids == pad],
+                          np.zeros((3, dim), np.float32))
+    assert np.abs(out[ids != pad]).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# hot-row cache
+# ---------------------------------------------------------------------------
+def test_hot_cache_exact_and_absorbs_zipf_head():
+    vocab, dim = 5000, 4
+    cfg = TableConfig("t_hot", vocab, dim, optimizer="sgd", lr=0.1,
+                      seed=9)
+    table = ShardedTable(cfg, mesh=_mesh(), hot_cache=True)
+    table.hot_cache.capacity = 64
+    table.hot_cache.refresh_interval = 3
+    table.hot_cache.ids = jnp.full((64,), np.iinfo(np.int32).max,
+                                   jnp.int32)
+    table.hot_cache.rows = jnp.zeros((64, dim), jnp.float32)
+    r = _rng(1)
+    hits = misses = 0
+    for step in range(12):
+        ids = r.zipf(1.3, size=32).clip(max=vocab - 1).astype(np.int64)
+        rows, uniq, inv, valid = table.lookup_unique(ids)
+        # cached rows must equal a direct sharded gather, bitwise —
+        # write-through + refresh keep the cache exact (single worker)
+        direct = masked_gather(table.param,
+                               jnp.where(valid, uniq, table.sentinel),
+                               table.mesh, "model")
+        assert np.array_equal(np.asarray(rows), np.asarray(direct))
+        grads = r.standard_normal(
+            (uniq.shape[0], dim)).astype(np.float32)
+        table.apply_rows(uniq, valid, grads)
+        if step >= 6:    # after the first refreshes
+            _r, h, m = table.hot_cache.lookup(table, uniq, valid)
+            hits, misses = hits + h, misses + m
+    assert table.hot_cache.refreshes >= 2
+    assert hits / max(hits + misses, 1) > 0.5, (hits, misses)
+
+
+def test_cached_gather_miss_budget_and_overflow():
+    vocab, dim = 64, 3
+    r = _rng(7)
+    param = jnp.asarray(r.standard_normal((vocab, dim))
+                        .astype(np.float32))
+    cache_ids = jnp.asarray(np.array([2, 5, 9], np.int32))
+    cache_rows = jnp.take(param, cache_ids, axis=0)
+    uniq = jnp.asarray(np.array([2, 5, 11, 20, 64, 64], np.int32))
+    valid = uniq < vocab
+    # budget covers the 2 misses: rows exact, no overflow
+    rows, h, m, ovf = cached_gather(param, cache_ids, cache_rows,
+                                    uniq, valid, sentinel=vocab,
+                                    miss_budget=2)
+    assert (int(h), int(m), bool(ovf)) == (2, 2, False)
+    np.testing.assert_array_equal(np.asarray(rows[:4]),
+                                  np.asarray(param)[[2, 5, 11, 20]])
+    np.testing.assert_array_equal(np.asarray(rows[4:]), 0.0)
+    # budget of 1 cannot carry 2 misses: loud overflow flag
+    _rows, _h, _m, ovf = cached_gather(param, cache_ids, cache_rows,
+                                       uniq, valid, sentinel=vocab,
+                                       miss_budget=1)
+    assert bool(ovf) is True
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: per-shard pieces, bit-identical restore, no densify
+# ---------------------------------------------------------------------------
+def test_checkpoint_roundtrip_per_shard(tmp_path):
+    cfg = TableConfig("t_ckpt", 120, 5, optimizer="adam", lr=0.01,
+                      seed=6)
+    table = ShardedTable(cfg, mesh=_mesh())
+    r = _rng(3)
+    for _ in range(2):
+        table.apply_gradients(
+            r.integers(0, 120, size=12),
+            r.standard_normal((12, 5)).astype(np.float32))
+    d = str(tmp_path / "ck")
+    save_table(d, table)
+    # the index must show one piece per shard for param and both
+    # moments — a lone piece with an empty index key would mean the
+    # array was densified on save
+    with open(os.path.join(d, "index.json")) as f:
+        index = json.load(f)
+    for name in ("t_ckpt.param", "t_ckpt.moment1", "t_ckpt.moment2"):
+        pieces = index["vars"][name]["pieces"]
+        assert len(pieces) == 8, (name, pieces)
+        assert all(p["index"] for p in pieces), (name, pieces)
+    got = load_table(d, mesh=_mesh())
+    assert got.step == table.step
+    assert np.array_equal(np.asarray(got.param),
+                          np.asarray(table.param))
+    for s in ("moment1", "moment2", "beta1_pow", "beta2_pow"):
+        assert np.array_equal(np.asarray(got.slots[s]),
+                              np.asarray(table.slots[s]))
+    # restored array is still row-sharded over the mesh
+    spec = got.param.sharding.spec
+    assert tuple(spec)[0] == "model", spec
+
+
+# ---------------------------------------------------------------------------
+# chaos drill: crash + restore mid-epoch == uninterrupted run, bitwise
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_chaos_crash_restore_deepfm_sharded(tmp_path):
+    """DeepFM on sharded tables: train 4 batches, checkpoint, 'crash'
+    (all objects discarded), restore into a fresh model, train batches
+    4..8 — final param AND per-shard optimizer slot state must be
+    bitwise identical to the uninterrupted 8-batch run."""
+    from paddle_tpu.models.deepfm import DeepFMSharded
+
+    def batches(n, fields=4, vocab=500, bs=8):
+        r = _rng(42)
+        out = []
+        for _ in range(n):
+            out.append((
+                r.zipf(1.3, size=(bs, fields)).clip(max=vocab - 1)
+                 .astype(np.int64)[..., None],
+                r.standard_normal((bs, fields)).astype(np.float32),
+                (r.random((bs, 1)) < 0.5).astype(np.float32)))
+        return out
+
+    def fresh():
+        return DeepFMSharded(num_features=500, num_fields=4,
+                             embed_dim=4, layer_sizes=(8,),
+                             optimizer="adam", lr=1e-3,
+                             mesh=make_mesh((1, 8), ("data", "model")),
+                             seed=1)
+
+    data = batches(8)
+    ref = fresh()
+    for ids, vals, lab in data:
+        ref.train_step(ids, vals, lab)
+
+    m = fresh()
+    for ids, vals, lab in data[:4]:
+        m.train_step(ids, vals, lab)
+    ck = str(tmp_path / "mid_epoch")
+    m.save(ck)
+    del m                                    # the crash
+
+    m2 = fresh()                             # fresh process stand-in
+    m2.restore(ck)
+    assert m2.step == 4
+    for ids, vals, lab in data[m2.step:]:
+        m2.train_step(ids, vals, lab)
+
+    for name, a, b in (("w1", ref.w1, m2.w1), ("emb", ref.emb, m2.emb)):
+        assert np.array_equal(np.asarray(a.param),
+                              np.asarray(b.param)), name
+        for s in a.slots:
+            assert np.array_equal(np.asarray(a.slots[s]),
+                                  np.asarray(b.slots[s])), (name, s)
+    for k in ref.dense:
+        assert np.array_equal(np.asarray(ref.dense[k]),
+                              np.asarray(m2.dense[k])), k
+        for s in ref.dense_slots[k]:
+            assert np.array_equal(np.asarray(ref.dense_slots[k][s]),
+                                  np.asarray(m2.dense_slots[k][s])), \
+                (k, s)
+
+
+# ---------------------------------------------------------------------------
+# cost model: sparse path priced by touched rows (hand counts)
+# ---------------------------------------------------------------------------
+def _sparse_op_program(kind, vocab, u, dim):
+    main = pt.Program()
+    blk = main.global_block()
+    for name, sh, dt in (("p", [vocab, dim], "float32"),
+                         ("g", [u, dim], "float32"),
+                         ("ids", [u], "int64"), ("lr", [1], "float32"),
+                         ("m", [vocab, dim], "float32"),
+                         ("m2", [vocab, dim], "float32"),
+                         ("b1p", [1], "float32"),
+                         ("b2p", [1], "float32")):
+        blk.create_var(name, shape=sh, dtype=dt)
+    ins = {"Param": "p", "Grad": "g", "Ids": "ids",
+           "LearningRate": "lr"}
+    outs = {"ParamOut": "p"}
+    if kind == "sparse_adagrad":
+        ins["Moment"] = "m"
+        outs["MomentOut"] = "m"
+    if kind == "sparse_adam":
+        ins.update({"Moment1": "m", "Moment2": "m2", "Beta1Pow": "b1p",
+                    "Beta2Pow": "b2p"})
+        outs.update({"Moment1Out": "m", "Moment2Out": "m2",
+                     "Beta1PowOut": "b1p", "Beta2PowOut": "b2p"})
+    blk.append_op(kind, ins, outs)
+    return main
+
+
+@pytest.mark.parametrize("kind,flops_per,slots", [
+    ("sparse_sgd", 2, 0), ("sparse_adagrad", 6, 1),
+    ("sparse_adam", 12, 2)])
+def test_sparse_apply_cost_hand_counts(kind, flops_per, slots):
+    """Hand counts: FLOPs = rule x GRAD numel (not Param numel — the
+    dense rule would overcount by vocab/touched); bytes = param
+    read+write + grad read per touched row, read+write per row slot,
+    plus the deduped ids. Both must be flat in vocab."""
+    from paddle_tpu.analysis import cost_model
+    u, dim = 32, 8
+    for vocab in (1000, 100000):
+        cost = cost_model.program_cost(_sparse_op_program(
+            kind, vocab, u, dim))
+        (op,) = [c for c in cost.ops if c.op_type == kind]
+        assert op.flops == flops_per * u * dim and op.exact
+        assert op.bytes_accessed == \
+            (3 + 2 * slots) * u * dim * 4 + u * 8
+        assert op.note and "touched" in op.note
+
+
+# ---------------------------------------------------------------------------
+# the sparse IR ops themselves (executor path) vs the dense op
+# ---------------------------------------------------------------------------
+def test_sparse_sgd_op_matches_dense_on_touched_rows():
+    from op_test import OpTestHarness
+    r = _rng(8)
+    vocab, dim = 20, 4
+    p = r.standard_normal((vocab, dim)).astype(np.float32)
+    ids = np.array([3, 7, 3, 19, 25, -1], np.int64)   # dup + OOB
+    g_occ = r.standard_normal((6, dim)).astype(np.float32)
+    # dedup occurrence grads onto unique in-range rows
+    uniq = np.array([3, 7, 19], np.int64)
+    g_rows = np.zeros((3, dim), np.float32)
+    for i, v in enumerate([3, 7, 3, 19]):
+        g_rows[list(uniq).index(v)] += g_occ[i]
+    lr = np.array([0.1], np.float32)
+    t = OpTestHarness("sparse_sgd",
+                      {"Param": ("p", p), "Grad": ("g", g_rows),
+                       "Ids": ("ids", uniq),
+                       "LearningRate": ("lr", lr)},
+                      out_slots=("ParamOut",))
+    got = t.outputs()["ParamOut"]
+    ref = p.copy()
+    ref[uniq] = p[uniq] - 0.1 * g_rows
+    np.testing.assert_array_equal(got, ref)
+    # OOB ids are dropped, not clipped onto row 0 / row vocab-1
+    t2 = OpTestHarness("sparse_sgd",
+                       {"Param": ("p", p),
+                        "Grad": ("g", g_rows),
+                        "Ids": ("ids",
+                                np.array([-1, 25, 20], np.int64)),
+                        "LearningRate": ("lr", lr)},
+                       out_slots=("ParamOut",))
+    np.testing.assert_array_equal(t2.outputs()["ParamOut"], p)
+
+
+# ---------------------------------------------------------------------------
+# serving: a distributed=True export runs sharded under the lifecycle
+# ---------------------------------------------------------------------------
+def test_sharded_servable_parity_and_lifecycle(tmp_path):
+    from paddle_tpu import serving
+    from paddle_tpu.embedding import load_sharded_servable
+    from paddle_tpu.models.deepfm import deepfm
+
+    pt.reset_default_programs()
+    pt.reset_global_scope()
+    vocab, fields = 200, 3
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = 0
+    with pt.program_guard(main, startup):
+        ids = layers.data("feat_ids", [fields, 1], dtype="int64")
+        vals = layers.data("feat_vals", [fields], dtype="float32")
+        label = layers.data("label", [1], dtype="float32")
+        pred, _loss = deepfm(ids, vals, label, num_features=vocab,
+                             embed_dim=4, layer_sizes=(8,),
+                             distributed=True)
+    exe = pt.Executor()
+    exe.run(startup)
+    d = str(tmp_path / "deepfm_dist")
+    pt.io.save_inference_model(d, ["feat_ids", "feat_vals"], [pred],
+                               exe, main_program=main,
+                               model_version="v1")
+    r = _rng(12)
+    feed = {"feat_ids": r.integers(0, vocab, size=(4, fields, 1))
+            .astype(np.int64),
+            "feat_vals": r.standard_normal((4, fields))
+            .astype(np.float32)}
+    # dense single-chip reference: plain executor, no mesh in play
+    (ref,) = exe.run(main, feed=dict(feed, label=np.zeros(
+        (4, 1), np.float32)), fetch_list=[pred])
+
+    model = load_sharded_servable(d)
+    # the table really is row-sharded in the servable's scope
+    w_names = [p for p in model.scope.local_names()
+               if p in model.executor.sharding.specs]
+    assert len(w_names) == 2, w_names
+    for w in w_names:
+        spec = model.scope.get(w).sharding.spec
+        assert tuple(spec)[0] == "model", (w, spec)
+    (got,) = model.predict(feed)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    # and it drops into the PR 7 lifecycle unchanged
+    host = serving.ModelHost(
+        model, config=serving.BatchingConfig(max_batch_size=4,
+                                             batch_buckets=[4],
+                                             max_latency_ms=1.0),
+        warmup=False).start()
+    try:
+        out = host.predict(
+            {k: v[:1] for k, v in feed.items()}, timeout=60)
+        np.testing.assert_allclose(np.asarray(out[0]),
+                                   np.asarray(ref)[:1], rtol=1e-5,
+                                   atol=1e-6)
+    finally:
+        host.stop(timeout=120)

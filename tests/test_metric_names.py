@@ -61,6 +61,7 @@ def _smoke_train_and_serve(tmp_path):
     finally:
         host.stop(timeout=120)
     _smoke_generation()
+    _smoke_embedding()
     return host.host_label
 
 
@@ -91,6 +92,23 @@ def _smoke_generation():
             raise AssertionError("budget=0 submit was not shed")
     finally:
         host.stop(timeout=120)
+
+
+def _smoke_embedding():
+    """Populate the sharded-embedding families (ISSUE 19): a few
+    hot-cached ShardedTable steps, forcing one cache refresh so every
+    paddle_tpu_embed_* family carries samples."""
+    from paddle_tpu.embedding import ShardedTable, TableConfig
+    table = ShardedTable(TableConfig("metrics_smoke", vocab=64, dim=4,
+                                     optimizer="adagrad", lr=0.1),
+                         mesh=None, hot_cache=True)
+    table.hot_cache.refresh_interval = 1   # refresh on the first apply
+    rng = np.random.RandomState(0)
+    for _ in range(2):
+        ids = rng.randint(0, 64, size=(8,))
+        table.apply_gradients(
+            ids, rng.rand(8, 4).astype(np.float32))
+        table.lookup(ids)
 
 
 def test_registry_names_and_help_after_smoke_run(tmp_path):
@@ -131,7 +149,18 @@ def test_registry_names_and_help_after_smoke_run(tmp_path):
                      "paddle_tpu_decode_slots_total",
                      "paddle_tpu_decode_host_requests_total",
                      "paddle_tpu_decode_host_swaps_total",
-                     "paddle_tpu_decode_host_models"):
+                     "paddle_tpu_decode_host_models",
+                     # ISSUE 19: sharded-embedding families
+                     "paddle_tpu_embed_lookups_total",
+                     "paddle_tpu_embed_ids_total",
+                     "paddle_tpu_embed_hot_cache_hits_total",
+                     "paddle_tpu_embed_hot_cache_misses_total",
+                     "paddle_tpu_embed_hot_cache_hit_ratio",
+                     "paddle_tpu_embed_touched_rows",
+                     "paddle_tpu_embed_applies_total",
+                     "paddle_tpu_embed_cache_refreshes_total",
+                     "paddle_tpu_embed_cache_staleness_steps",
+                     "paddle_tpu_embed_table_rows"):
         assert expected in names, f"smoke run did not publish {expected}"
     # the generation smoke shed exactly through the host budget path
     gen_shed = {key for key, _ in

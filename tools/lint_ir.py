@@ -168,6 +168,17 @@ def _build_ifelse():
     return main, startup, ["x"], [out.name]
 
 
+def _build_deepfm_distributed():
+    """DeepFM with is_distributed=True lookup tables — the IR program
+    a sharded-embedding (paddle_tpu.embedding) deployment exports and
+    serves; keeps the sharded-lookup op surface verifier-clean."""
+    from paddle_tpu.models.deepfm import build_train
+    main, startup, f = build_train(num_features=1000, num_fields=5,
+                                   embed_dim=4, distributed=True)
+    return main, startup, ["feat_ids", "feat_vals", "label"], \
+        [f["loss"].name, f["pred"].name]
+
+
 def _build_decoder_lm_step():
     """The token-serving decode-step program: single-token forward
     reading/writing the persistable KV cache through the donated
@@ -197,6 +208,7 @@ NETWORKS = {
     "dynamic_rnn": _build_dynamic_rnn,
     "ifelse": _build_ifelse,
     "decoder_lm_step": _build_decoder_lm_step,
+    "deepfm_distributed": _build_deepfm_distributed,
 }
 
 
