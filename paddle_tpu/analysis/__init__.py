@@ -18,6 +18,8 @@ from .verifier import (ProgramVerifier, clear_gate_cache,  # noqa
                        executor_gate, verify_enabled, verify_program)
 from .cost_model import (CostModelPass, OpCost, ProgramCost,  # noqa
                          program_cost)
+from .memory import (MemoryPass, MemoryReport, VarInterval,  # noqa
+                     check_budget, hbm_budget_bytes, program_memory)
 from .rewrite import (RewritePass, RewriteResult,  # noqa
                       REWRITE_PASS_REGISTRY, default_rewrite_passes,
                       optimize_enabled, rewrite_program)
@@ -28,6 +30,8 @@ __all__ = [
     "register_pass", "ProgramVerifier", "verify_program",
     "verify_enabled", "executor_gate", "clear_gate_cache",
     "CostModelPass", "OpCost", "ProgramCost", "program_cost",
+    "MemoryPass", "MemoryReport", "VarInterval", "check_budget",
+    "hbm_budget_bytes", "program_memory",
     "RewritePass", "RewriteResult", "REWRITE_PASS_REGISTRY",
     "default_rewrite_passes", "optimize_enabled", "rewrite_program",
 ]

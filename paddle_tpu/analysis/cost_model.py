@@ -33,11 +33,15 @@ from ..core import ir
 from .passes import AnalysisPass, PassContext, iter_ops, register_pass
 
 __all__ = ["OpCost", "ProgramCost", "program_cost", "CostModelPass",
-           "ZERO_FLOP_OPS"]
+           "ZERO_FLOP_OPS", "ITEMSIZE"]
 
 _ITEMSIZE = {"float64": 8, "int64": 8, "float32": 4, "int32": 4,
              "float16": 2, "bfloat16": 2, "int16": 2, "int8": 1,
              "uint8": 1, "bool": 1}
+
+#: public alias — the memory planner (analysis/memory.py) binds shapes
+#: to bytes with the same table so the two analyses can never disagree
+ITEMSIZE = _ITEMSIZE
 
 #: ops that move/alias/select data without arithmetic — zero FLOPs by
 #: contract (their bytes still count: a transpose is pure HBM traffic)

@@ -91,6 +91,8 @@ class VerifyReport:
         self.program_label = program_label
         # filled by the cost_model pass when it runs in the pipeline
         self.cost = None
+        # filled by the memory pass / budget gate (analysis/memory.py)
+        self.memory = None
 
     def add(self, diag: Diagnostic) -> Diagnostic:
         self.diagnostics.append(diag)

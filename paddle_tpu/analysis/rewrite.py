@@ -57,7 +57,8 @@ import numpy as np
 from ..core import ir
 from ..core.ir import SUB_BLOCK_ATTRS
 from ..core.registry import OpRegistry, run_op
-from .passes import fast_passes, iter_blocks, iter_ops
+from .cost_model import ITEMSIZE as _ITEMSIZE
+from .passes import fast_passes, iter_blocks, iter_ops, rw_state_names
 from .verifier import verify_program
 
 __all__ = ["optimize_enabled", "RewritePass", "RewriteResult",
@@ -1143,18 +1144,162 @@ class KernelDispatch(RewritePass):
 
 
 # ---------------------------------------------------------------------------
+# in-place buffer reuse
+# ---------------------------------------------------------------------------
+@register_rewrite_pass
+class InplaceBufferReuse(RewritePass):
+    """Liveness-driven buffer reuse: rename an op's output var onto a
+    same-signature buffer whose live interval already ended, so the
+    executor's name-keyed env (and XLA's arena under it) holds ONE
+    buffer where the unoptimized program declared two. The classic
+    win: backward grads folding into the dead forward activations of
+    the same shape (analysis/memory.py's ``peak_bytes`` is exactly the
+    number this shrinks).
+
+    Root-block scoped and value-preserving — a pure renaming, so the
+    loss-identity gate stays bit-exact. A name participates (as donor
+    or target) only when it is root-declared, non-persistable, not a
+    parameter, un-initialized, dense (lod 0), single-writer, not fed /
+    fetched / attr-referenced / donated rw state, and never referenced
+    from a sub-block; targets additionally must not be written by
+    plumbing (feed/fetch/print), stateful, or sub-block-carrying ops.
+    Signature = exact dtype + exact dims with ``-1`` kept symbolic, so
+    two dynamic-batch buffers match only when their runtime sizes are
+    equal for every batch. A donor frees AFTER the op holding its last
+    reference (never within it), which rules out aliasing an op's
+    input to its own output.
+
+    Runs LAST in the pipeline: the outliners match ``__vjp__`` grad
+    ops against the forward op's exact input/output names, which
+    renaming would break."""
+
+    name = "inplace_reuse"
+
+    def apply(self, program, ctx) -> List[Dict]:
+        if os.environ.get("PADDLE_TPU_INPLACE_REUSE", "1") == "0":
+            return []
+        root = program.blocks[ctx.block_idx]
+        writers = _writer_counts(program, ctx.block_idx)
+        attr_names = _attr_referenced_names(program, ctx.block_idx)
+        fetches = set(ctx.fetch_names)
+        feeds = set(ctx.feed_names)
+        donated = set(rw_state_names(program, ctx.block_idx))
+        # names a sub-block touches read the enclosing scope closure
+        # style — renaming them needs a cross-block sweep; stay
+        # root-scoped (KNOWN_GAPS: memory-planning boundaries)
+        nonroot: Set[str] = set()
+        for blk, _path, _i, op in iter_ops(program, ctx.block_idx):
+            if blk.idx != root.idx:
+                nonroot.update(op.input_names())
+                nonroot.update(op.output_names())
+
+        def sig(name: str) -> Optional[Tuple]:
+            v = root.vars.get(name)
+            if v is None or v.shape is None or v.dtype is None:
+                return None
+            dims = []
+            for d in v.shape:
+                if not isinstance(d, int):
+                    return None  # symbolic placeholder: size unknowable
+                dims.append(int(d))
+            return (v.dtype, tuple(dims))
+
+        def static_bytes(s: Tuple) -> int:
+            n = 1
+            for d in s[1]:
+                n *= 1 if d == -1 else d
+            return n * _ITEMSIZE.get(s[0], 4)
+
+        def eligible(name: str) -> bool:
+            v = root.vars.get(name)
+            if v is None or v.persistable or v.is_parameter:
+                return False
+            if v.initializer is not None or v.lod_level:
+                return False
+            if v.type != ir.VAR_TYPE_LOD_TENSOR:
+                return False
+            if name in fetches or name in feeds or name in attr_names \
+                    or name in nonroot or name in donated:
+                return False
+            if writers.get(name, 0) != 1:
+                return False
+            return sig(name) is not None
+
+        last_ref: Dict[str, int] = {}
+        for i, op in enumerate(root.ops):
+            for n in op.input_names():
+                last_ref[n] = i
+            for n in op.output_names():
+                last_ref[n] = i
+        deaths: Dict[int, List[str]] = {}
+        for n, i in last_ref.items():
+            if eligible(n):
+                deaths.setdefault(i, []).append(n)
+
+        assignments: Dict[str, str] = {}  # renamed name -> buffer name
+        free: Dict[Tuple, List[str]] = {}
+        actions: List[Dict] = []
+        for i, op in enumerate(root.ops):
+            if op.type not in _KEEP_OPS and not _has_sub_block(op) \
+                    and not _is_stateful(op):
+                ins = set(op.input_names())
+                for n in op.output_names():
+                    if n in assignments or n in ins or not eligible(n):
+                        continue
+                    s = sig(n)
+                    pool = free.get(s)
+                    if not pool:
+                        continue
+                    donor = pool.pop()
+                    assignments[n] = donor
+                    actions.append({"action": "reuse",
+                                    "op_type": op.type, "op_index": i,
+                                    "var": n, "into": donor,
+                                    "bytes": static_bytes(s)})
+            # a buffer whose last reference sits at op i is reusable
+            # from op i+1 on; a renamed var's death returns the
+            # UNDERLYING buffer to the pool (chained reuse)
+            for n in sorted(deaths.get(i, ())):
+                free.setdefault(sig(n), []).append(
+                    assignments.get(n, n))
+
+        if not assignments:
+            return []
+        for op in root.ops:
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [assignments.get(n, n)
+                                   for n in names]
+            for slot, names in op.outputs.items():
+                op.outputs[slot] = [assignments.get(n, n)
+                                    for n in names]
+            # legacy memory-optimize annotations pin liveness decisions
+            # made before the renaming — scrub touched names
+            dead = op.attrs.get("__dead_vars__")
+            if dead:
+                keep = set(assignments) | set(assignments.values())
+                op.attrs["__dead_vars__"] = [n for n in dead
+                                             if n not in keep]
+        for n in assignments:
+            root.vars.pop(n, None)
+        program._bump_version()
+        return actions
+
+
+# ---------------------------------------------------------------------------
 # the pipeline
 # ---------------------------------------------------------------------------
 def default_rewrite_passes() -> List[RewritePass]:
     """THE rewrite pipeline, in order: fold and dedup first (cheaper
     graphs for the matchers), prune dead gradients (which unblocks
     outlining on masked attention), outline fusable subgraphs, sweep
-    dead ops (including producers orphaned by folding/outlining), then
-    stamp kernel dispatch."""
+    dead ops (including producers orphaned by folding/outlining), stamp
+    kernel dispatch, then alias dead buffers (last: the outliners match
+    grad ops by exact forward names, which renaming would break)."""
     return [ConstantFolding(), CommonSubexpressionElimination(),
             DeadOpElimination(), DeadGradPruning(),
             AttentionOutlining(), SEBlockOutlining(),
-            DeadOpElimination(), KernelDispatch()]
+            DeadOpElimination(), KernelDispatch(),
+            InplaceBufferReuse()]
 
 
 class RewriteResult:
@@ -1212,16 +1357,25 @@ def _publish(seconds: float, actions: List[Dict],
                     "paddle_tpu_rewrite_ops_total",
                     "Program-rewrite actions applied, by pass and "
                     "action (remove_op/merge_op/fold_op/outline/"
-                    "dispatch; 'aborted' counts a pass whose "
+                    "dispatch/reuse; 'aborted' counts a pass whose "
                     "post-rewrite verification failed and whose "
                     "changes were discarded).",
                     ("pass", "action")),
+                reg.counter(
+                    "paddle_tpu_memory_reuse_bytes_total",
+                    "Static activation bytes the in-place buffer-reuse "
+                    "rewrite folded into dead predecessor buffers "
+                    "(per adopted pipeline run, by pass).",
+                    ("pass",)),
             )
-        _, hist, ops_total = _obs_cache
+        _, hist, ops_total, reuse_total = _obs_cache
         hist.record(seconds)
         for a in actions:
             ops_total.labels(**{"pass": a["pass"],
                                 "action": a["action"]}).inc()
+            if a["action"] == "reuse" and a.get("bytes"):
+                reuse_total.labels(**{"pass": a["pass"]}).inc(
+                    int(a["bytes"]))
         for name in aborted:
             ops_total.labels(**{"pass": name, "action": "aborted"}).inc()
     except Exception:

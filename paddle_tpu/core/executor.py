@@ -548,6 +548,9 @@ class Executor:
         # static ProgramCost of the most recently dispatched executable
         # — the numerator of the live MFU gauge (trainer, serving)
         self.last_cost = None
+        # static MemoryReport of the same executable (analysis/memory):
+        # peak-HBM estimate + liveness, attached next to last_cost
+        self.last_memory = None
         _LIVE_EXECUTORS.add(self)
 
     # ------------------------------------------------------------------
@@ -903,28 +906,55 @@ class Executor:
                 if rewrite_result is not None and rewrite_result.changed:
                     exec_program = rewrite_result.program
                     exec_block = exec_program.block(block_idx)
+            # feed shapes of THIS dispatch, for the -1-dim binding of
+            # the memory plan and the cost model below (stacked feeds
+            # strip the leading K axis — both analyses are per traced
+            # iteration)
+            fs = {}
+            for fk, fv in feed_vals.items():
+                shp = getattr(fv, "shape", None)
+                if isinstance(shp, tuple):
+                    fs[fk] = shp[1:] if stacked_feed else shp
+            # Pre-compile OOM gate (analysis/memory.py): the static
+            # peak-HBM plan of the program ABOUT to be compiled — the
+            # rewritten graph, post buffer-reuse. An over-budget
+            # program (PADDLE_TPU_HBM_BYTES, 0 disables) raises a
+            # structured VerificationError naming the top offenders
+            # and the high-water op BEFORE XLA ever sees it, instead
+            # of an unattributed allocator failure deep inside
+            # compilation. The plan itself is best-effort; the budget
+            # check respects the PADDLE_TPU_VERIFY kill switch.
+            mem_report = None
+            try:
+                from ..analysis import memory as _memory
+                mem_report = _memory.program_memory(
+                    exec_program, block_idx, feed_shapes=fs,
+                    feed_names=feed.keys(),
+                    label=f"program uid={program.uid} "
+                          f"block={block_idx}")
+            except Exception:
+                mem_report = None
+            if mem_report is not None and _verifier.verify_enabled():
+                budget = _memory.hbm_budget_bytes()
+                if budget > 0 and mem_report.peak_bytes > budget:
+                    _memory.check_budget(
+                        mem_report, budget).raise_if_errors(
+                        context="pre-compile memory gate")
             compiled = self._compile(exec_program, exec_block, feed_sig,
                                      fetch_names, scope,
                                      while_bounds=while_bounds,
                                      donate=self.donate_state, **kw)
             # introspection: which rewrite produced this executable
             compiled.rewrite = rewrite_result
+            compiled.memory = mem_report
             # static cost attribution, attached once per compiled
             # executable: per-op FLOPs/bytes with the dynamic batch dim
-            # bound from THIS dispatch's feed shapes (stacked feeds
-            # strip the leading K axis — the cost is per traced
-            # iteration, matching the per-batch step_seconds the
-            # trainer divides by). Computed on the REWRITTEN program —
-            # the graph that actually runs — so MFU attribution stays
-            # correct post-rewrite. Best-effort: the cost model must
-            # never fail a compile.
+            # bound from THIS dispatch's feed shapes. Computed on the
+            # REWRITTEN program — the graph that actually runs — so
+            # MFU attribution stays correct post-rewrite. Best-effort:
+            # the cost model must never fail a compile.
             try:
                 from ..analysis import cost_model as _cost_model
-                fs = {}
-                for fk, fv in feed_vals.items():
-                    shp = getattr(fv, "shape", None)
-                    if isinstance(shp, tuple):
-                        fs[fk] = shp[1:] if stacked_feed else shp
                 compiled.cost = _cost_model.program_cost(
                     exec_program, block_idx, feed_shapes=fs)
             except Exception:
@@ -934,6 +964,7 @@ class Executor:
             self.cache_stats["hits"] += 1
             obs_hits.inc()
         self.last_cost = compiled.cost
+        self.last_memory = compiled.memory
 
         if not sync and self.donate_state:
             rw = set(compiled.rw_names)
@@ -998,6 +1029,7 @@ class Executor:
         # read the executor-global last_cost, which the next dispatch
         # overwrites
         result.cost = compiled.cost
+        result.memory = compiled.memory
         return result.fetches() if sync else result
 
     def cost_for(self, program):

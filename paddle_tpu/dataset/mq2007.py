@@ -76,8 +76,10 @@ def load_from_text(filepath: str, shuffle: bool = False,
                 if 0 <= i < FEATURE_DIM:
                     feats[i] = float(v)
             desc = line.split("#", 1)[1].strip() if "#" in line else ""
-            by_qid.setdefault(qid, QueryList(qid)).append(
-                Query(qid, rel, feats, description=desc))
+            ql = by_qid.get(qid)
+            if ql is None:
+                ql = by_qid[qid] = QueryList(qid)
+            ql.append(Query(qid, rel, feats, description=desc))
     out = list(by_qid.values())
     if shuffle:
         np.random.shuffle(out)

@@ -327,11 +327,16 @@ class AsyncParameterServer:
                     arr = np.array(v)
                     self._params[n] = arr
                     # params without saved state blobs (e.g. sgd) still
-                    # need their optimizer-state dict materialized
-                    self._state.setdefault(n, self._opt.make_state(arr))
-                    self._locks.setdefault(n, threading.Lock())
+                    # need their optimizer-state dict materialized;
+                    # guard before constructing so restore never builds
+                    # (and discards) state/locks for keys that exist
+                    if n not in self._state:
+                        self._state[n] = self._opt.make_state(arr)
+                    if n not in self._locks:
+                        self._locks[n] = threading.Lock()
                     self._versions.setdefault(n, 0)
-                    self._sync.setdefault(n, _SyncRound())
+                    if n not in self._sync:
+                        self._sync[n] = _SyncRound()
         self._init_done.set()
 
 

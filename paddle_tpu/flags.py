@@ -128,6 +128,21 @@ FLAGS: Dict[str, tuple] = {
         "onto the Pallas kernels, and kernel-dispatch annotation — "
         "each pass verified by fast_passes() and discarded on failure; "
         "0 compiles every program exactly as built"),
+    "PADDLE_TPU_INPLACE_REUSE": (
+        "1", "analysis/rewrite.py (inplace_reuse pass)",
+        "liveness-driven buffer reuse during rewrite: rename an op's "
+        "output onto a dead same-signature buffer so the arena holds "
+        "one allocation instead of two (value-preserving, root block "
+        "only, never touches persistable/donated/fetched names); "
+        "0 keeps every var its own buffer"),
+    "PADDLE_TPU_HBM_BYTES": (
+        str(16 * 1024 ** 3), "analysis/memory.py (gate in "
+        "core/executor.py)",
+        "per-core HBM budget for the pre-compile OOM gate: a program "
+        "whose static peak-memory estimate exceeds this raises a "
+        "structured VerificationError (top offenders + high-water op) "
+        "before XLA compiles it. Default one v5e core (16 GiB); "
+        "0 disables the gate (the MemoryReport is still attached)"),
     "PADDLE_TPU_PALLAS_SDPA": (
         "1", "analysis/rewrite.py (kernel_dispatch pass)",
         "flash-kernel dispatch annotation for "

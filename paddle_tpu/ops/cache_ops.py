@@ -21,7 +21,26 @@ import jax.numpy as jnp
 from ..core.registry import register_op
 
 
-@register_op("kv_cache_write", no_grad_slots=["Slot"])
+def _cache_passthrough_infer(block_desc, op):
+    """Out mirrors the Cache operand: both ops are in-place
+    dynamic-update-slices, so shape/dtype pass straight through. The
+    generic abstract trace cannot run them (integer index operands have
+    no declared feed values at build time); without this rule the
+    memory planner would see a shape-coverage gap exactly on the
+    cache-resident buffers it most needs to count."""
+    names = op.input("Cache")
+    outs = op.output("Out")
+    if not names or not outs:
+        return {}
+    v = block_desc.find_var_recursive(names[0])
+    if v is None or v.shape is None:
+        return {}
+    return {outs[0]: {"shape": list(v.shape), "dtype": v.dtype,
+                      "lod_level": 0}}
+
+
+@register_op("kv_cache_write", no_grad_slots=["Slot"],
+             infer_shape=_cache_passthrough_infer)
 def _kv_cache_write(ctx):
     """Prefill path: write one request's full-prompt K or V rows into
     its cache slot.
@@ -38,7 +57,8 @@ def _kv_cache_write(ctx):
         cache, new, (slot, 0, 0, 0)))
 
 
-@register_op("kv_cache_append", no_grad_slots=["Pos"])
+@register_op("kv_cache_append", no_grad_slots=["Pos"],
+             infer_shape=_cache_passthrough_infer)
 def _kv_cache_append(ctx):
     """Decode path: append one token's K or V row per slot, at each
     slot's own position.

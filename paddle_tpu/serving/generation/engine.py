@@ -422,6 +422,10 @@ class GenerationEngine:
                 self.metrics.set_mfu(
                     cost.flops / obs_attr.peak_flops() / (t1 - t0),
                     cost.flops)
+        mem = self.model.last_memory()
+        if mem is not None:
+            from ...analysis.memory import publish_peak
+            publish_peak(self.metrics._attr_job, mem.peak_bytes)
 
     # -- retire ------------------------------------------------------------
     def _deliver_token(self, slot: int, req: _Request, tok: int):

@@ -278,6 +278,11 @@ class GenerationModel:
         """Static cost of the most recent dispatch's executable."""
         return self.executor.last_cost
 
+    def last_memory(self):
+        """Static memory plan (analysis/memory.py MemoryReport) of the
+        most recent dispatch's executable."""
+        return getattr(self.executor, "last_memory", None)
+
     # ------------------------------------------------------------------
     def serve(self, config=None, metrics=None, health=None,
               mode: str = "cached"):

@@ -480,6 +480,13 @@ class Trainer:
                         m_pref.set(prefetcher.occupancy()
                                    if prefetcher is not None else 0)
                         t_prev = now
+                        # static peak-HBM plan of THIS dispatch's
+                        # executable (same result-not-executor rule as
+                        # the cost read below)
+                        mem = getattr(res, "memory", None)
+                        if mem is not None:
+                            from .analysis.memory import publish_peak
+                            publish_peak("train", mem.peak_bytes)
                         if attr_on:
                             # phase breakdown: measured host phases
                             # since the last dispatch + the device

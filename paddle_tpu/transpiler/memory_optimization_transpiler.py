@@ -28,6 +28,16 @@ user-invoked program mutation, with every pass gated by the static
 verifier. This module stays for the `__dead_vars__` trace-time
 annotation (which the rewrite layer respects and scrubs where its
 renames would invalidate them) and for reference API parity.
+
+Successor note (ISSUE 20): the reference's headline behavior — actual
+in-place var reuse driven by liveness — now lives in the verified
+pipeline too: `analysis/memory.py` is the planner (per-var live
+intervals, arena + ideal peak-HBM estimates, the executor's
+pre-compile `hbm-oom` gate) and the `inplace_reuse` rewrite pass is
+the reuse transform (dead-interval buffer renaming, adopted only when
+the post-pass verifier is clean, gated by the bit-exact loss-identity
+test). New code should call `analysis.memory.program_memory` /
+rely on the default rewrite pipeline rather than `memory_optimize()`.
 """
 from __future__ import annotations
 

@@ -9,7 +9,15 @@ metric-name lint from the observability PR (tests/test_metric_names.py):
   state-leak between Programs/tests;
 - ``lock.acquire()`` outside a ``with`` statement — a raise between
   acquire and release deadlocks the serving workers / training loop
-  (every lock in the codebase is expected to use context-manager form).
+  (every lock in the codebase is expected to use context-manager form);
+- ``threading.Thread(...)`` without an explicit ``daemon=`` — a
+  non-daemon worker thread keeps the interpreter alive after the main
+  thread exits (hung test runs, hung serving shutdowns);
+- ``dict.setdefault(k, <side-effectful call>)`` — the default is
+  evaluated EVERY call, even when the key exists: an expensive or
+  stateful constructor (``threading.Lock()``, optimizer-state
+  materialization) runs and is thrown away, and the discarded object's
+  side effects already happened.
 """
 import ast
 import os
@@ -98,10 +106,75 @@ def test_no_lock_acquire_outside_with():
         + "\n  ".join(offenders))
 
 
+# names whose bare-call results are cheap and side-effect-free; calling
+# them redundantly in a setdefault default is harmless by construction
+_PURE_BUILTIN_CALLS = frozenset({
+    "list", "dict", "set", "tuple", "frozenset", "len", "int", "float",
+    "str", "bool", "bytes"})
+
+
+def _thread_without_daemon(tree):
+    """Yield ``threading.Thread(...)`` / ``Thread(...)`` calls that do
+    not pass ``daemon=`` explicitly."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        named = (isinstance(f, ast.Attribute) and f.attr == "Thread") \
+            or (isinstance(f, ast.Name) and f.id == "Thread")
+        if named and not any(kw.arg == "daemon"
+                             for kw in node.keywords):
+            yield node
+
+
+def _setdefault_with_side_effectful_default(tree):
+    """Yield ``<expr>.setdefault(k, <Call>)`` where the default is a
+    call NOT on the pure-builtin allowlist: the call runs on every
+    lookup, even when the key already exists."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "setdefault"
+                and len(node.args) >= 2):
+            continue
+        d = node.args[1]
+        if isinstance(d, ast.Call) and not (
+                isinstance(d.func, ast.Name)
+                and d.func.id in _PURE_BUILTIN_CALLS):
+            yield node
+
+
+def test_no_thread_without_explicit_daemon():
+    offenders = []
+    for path in _py_files():
+        for node in _thread_without_daemon(_parse(path)):
+            offenders.append(f"{_rel(path)}:{node.lineno}")
+    assert not offenders, (
+        "threading.Thread(...) without daemon= — a non-daemon worker "
+        "keeps the interpreter alive after main exits; pass "
+        "daemon=True (or an explicit daemon=False with a join path):"
+        "\n  " + "\n  ".join(offenders))
+
+
+def test_no_setdefault_with_side_effectful_default():
+    offenders = []
+    for path in _py_files():
+        for node in _setdefault_with_side_effectful_default(
+                _parse(path)):
+            offenders.append(f"{_rel(path)}:{node.lineno}")
+    assert not offenders, (
+        "dict.setdefault(k, <call>) evaluates the default on EVERY "
+        "lookup — guard with `if k not in d:` / `d.get(k)` so the "
+        "constructor only runs when the key is missing:\n  "
+        + "\n  ".join(offenders))
+
+
 @pytest.mark.parametrize("snippet,expected", [
     ("try:\n    pass\nexcept:\n    pass\n", "bare"),
     ("def f(x=[]):\n    return x\n", "mutable"),
     ("import threading\nl = threading.Lock()\nl.acquire()\n", "acquire"),
+    ("import threading\nthreading.Thread(target=f)\n", "thread"),
+    ("d = {}\nd.setdefault('k', make_state(x))\n", "setdefault"),
 ])
 def test_lint_rules_detect_planted_defects(tmp_path, snippet, expected):
     """The rules themselves catch planted violations (guards against a
@@ -115,8 +188,27 @@ def test_lint_rules_detect_planted_defects(tmp_path, snippet, expected):
                    and any(isinstance(d, ast.List)
                            for d in n.args.defaults)
                    for n in ast.walk(tree))
-    else:
+    elif expected == "acquire":
         assert any(isinstance(n, ast.Call)
                    and isinstance(n.func, ast.Attribute)
                    and n.func.attr == "acquire"
                    for n in ast.walk(tree))
+    elif expected == "thread":
+        assert list(_thread_without_daemon(tree))
+    else:
+        assert list(_setdefault_with_side_effectful_default(tree))
+
+
+@pytest.mark.parametrize("snippet", [
+    # explicit daemon= (either value) satisfies the thread rule
+    "import threading\nthreading.Thread(target=f, daemon=True)\n",
+    "import threading\nthreading.Thread(target=f, daemon=False)\n",
+    # pure-builtin and literal defaults satisfy the setdefault rule
+    "d = {}\nd.setdefault('k', [])\n",
+    "d = {}\nd.setdefault('k', tuple(x))\n",
+    "d = {}\nd.setdefault('k', len(x))\n",
+])
+def test_lint_rules_allow_benign_forms(snippet):
+    tree = ast.parse(snippet)
+    assert not list(_thread_without_daemon(tree))
+    assert not list(_setdefault_with_side_effectful_default(tree))

@@ -41,6 +41,30 @@ def test_json_output_parses(capsys):
     assert payload["counts"]["error"] == 0
 
 
+@pytest.mark.parametrize("name", sorted(lint_ir.NETWORKS))
+def test_every_named_network_fits_default_hbm_budget(name, capsys):
+    """--memory works on every named network and the static peak stays
+    under the default pre-compile budget (one v5e core): the suite's
+    programs must never trip the executor OOM gate out of the box."""
+    from paddle_tpu.analysis import memory
+    rc = lint_ir.main(["--network", name, "--memory", "--json"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["peak_bytes"] > 0
+    assert payload["peak_bytes"] <= memory.DEFAULT_HBM_BYTES
+    assert payload["ideal_peak_bytes"] <= payload["peak_bytes"]
+    assert payload["high_water"]["op_index"] >= 0
+    assert len(payload["top"]) > 0
+
+
+def test_memory_table_mode(capsys):
+    rc = lint_ir.main(["--network", "mnist_mlp", "--memory"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "peak" in out and "high water" in out
+    assert "resident" in out and "activation" in out
+
+
 def test_list_networks(capsys):
     assert lint_ir.main(["--list-networks"]) == 0
     listed = capsys.readouterr().out.split()

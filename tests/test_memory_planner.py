@@ -1,0 +1,321 @@
+"""Static memory planner (analysis/memory.py) + in-place buffer reuse
+(analysis/rewrite.py InplaceBufferReuse) + the executor's pre-compile
+OOM gate: liveness intervals, arena/ideal peaks, reuse safety, budget
+diagnostics, flags, and metric publication."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.analysis import memory, rewrite, verify_program
+from paddle_tpu.analysis.diagnostics import VerificationError
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mlp(hidden=(64, 64), train=True):
+    """3-layer MLP train graph: enough distinct activation intervals
+    for reuse to engage, small enough to hand-check."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [32])
+        y = layers.data("y", [1])
+        h = x
+        for width in hidden:
+            h = layers.fc(h, size=width, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square(
+            layers.elementwise_sub(pred, y)))
+        if train:
+            optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+# ---------------------------------------------------------------------------
+# flags
+# ---------------------------------------------------------------------------
+def test_memory_flags_registered():
+    from paddle_tpu import flags
+    for name, default in (
+            ("PADDLE_TPU_HBM_BYTES", str(16 * 1024 ** 3)),
+            ("PADDLE_TPU_INPLACE_REUSE", "1")):
+        assert name in flags.FLAGS, name
+        assert flags.FLAGS[name][0] == default
+
+
+def test_hbm_budget_env(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_HBM_BYTES", raising=False)
+    assert memory.hbm_budget_bytes() == memory.DEFAULT_HBM_BYTES
+    monkeypatch.setenv("PADDLE_TPU_HBM_BYTES", "1000000")
+    assert memory.hbm_budget_bytes() == 1000000
+    monkeypatch.setenv("PADDLE_TPU_HBM_BYTES", "0")
+    assert memory.hbm_budget_bytes() == 0
+    monkeypatch.setenv("PADDLE_TPU_HBM_BYTES", "not-a-number")
+    assert memory.hbm_budget_bytes() == memory.DEFAULT_HBM_BYTES
+
+
+# ---------------------------------------------------------------------------
+# liveness + peak accounting
+# ---------------------------------------------------------------------------
+def test_liveness_intervals_and_byte_accounting():
+    main, _startup, _loss = _mlp(train=False)
+    rep = memory.program_memory(main, batch=4,
+                                feed_names=["x", "y"])
+    by_name = {v.name: v for v in rep.intervals}
+    # feeds materialize before op 0 with -1 bound to batch
+    assert by_name["x"].first == 0
+    assert by_name["x"].bytes == 4 * 32 * 4
+    # params are resident for the whole step
+    w = by_name["fc_0.w_0"]
+    assert w.kind == "resident"
+    assert (w.first, w.last) == (0, rep.n_ops - 1)
+    assert w.bytes == 32 * 64 * 4
+    # every interval is sane and the totals tie out
+    for v in rep.intervals:
+        assert 0 <= v.first <= v.last <= rep.n_ops - 1, v.name
+    assert rep.peak_bytes == rep.resident_bytes + rep.activation_bytes
+    assert rep.peak_bytes == sum(v.bytes for v in rep.intervals)
+
+
+def test_ideal_peak_bounded_by_arena_peak():
+    main, _startup, _loss = _mlp()
+    rep = memory.program_memory(main, batch=4, feed_names=["x", "y"])
+    assert 0 < rep.ideal_peak_bytes <= rep.peak_bytes
+    assert rep.resident_bytes <= rep.ideal_peak_bytes
+    # report surfaces are well-formed
+    d = rep.to_dict(top_k=5)
+    assert len(d["top"]) == 5
+    assert d["high_water"]["op_index"] >= 0
+    json.loads(rep.to_json())
+    assert "peak" in rep.table()
+
+
+def test_memory_pass_attaches_report_to_verify():
+    main, startup, loss = _mlp()
+    rep = verify_program(main, startup=startup, feed_names=["x", "y"],
+                         fetch_names=[loss.name],
+                         passes=[memory.MemoryPass(batch=4)])
+    assert rep.memory is not None
+    assert rep.memory.peak_bytes > 0
+
+
+# ---------------------------------------------------------------------------
+# in-place reuse: effect + safety
+# ---------------------------------------------------------------------------
+def _rewrite_planned(main, loss, arm, batch=4):
+    os.environ["PADDLE_TPU_INPLACE_REUSE"] = arm
+    try:
+        res = rewrite.rewrite_program(main, feed_names=["x", "y"],
+                                      fetch_names=[loss.name])
+        return res, memory.program_memory(res.program, batch=batch,
+                                          feed_names=["x", "y"])
+    finally:
+        os.environ.pop("PADDLE_TPU_INPLACE_REUSE", None)
+
+
+def test_reuse_reduces_arena_peak_and_is_adopted_clean():
+    main, _startup, loss = _mlp()
+    res_off, mem_off = _rewrite_planned(main, loss, "0")
+    res_on, mem_on = _rewrite_planned(main, loss, "1")
+    assert res_off.count(pass_name="inplace_reuse") == 0
+    assert res_on.count(pass_name="inplace_reuse") > 0
+    assert "inplace_reuse" not in res_on.aborted
+    assert mem_on.peak_bytes < mem_off.peak_bytes
+    # every action carries the static byte size it folded away
+    for a in res_on.actions:
+        if a["pass"] == "inplace_reuse":
+            assert a["action"] == "reuse" and a["bytes"] > 0
+            assert a["var"] != a["into"]
+
+
+def test_reuse_never_touches_fetched_persistable_or_fed_names():
+    main, _startup, loss = _mlp()
+    res, _mem = _rewrite_planned(main, loss, "1")
+    renamed = {a["var"] for a in res.actions
+               if a["pass"] == "inplace_reuse"}
+    root = res.program.blocks[0]
+    protected = {"x", "y", loss.name}
+    protected |= {n for n, v in
+                  main.desc.blocks[0].vars.items() if v.persistable}
+    assert not renamed & protected, renamed & protected
+    # fetched/fed/persistable names all survive in the rewritten graph
+    live = set()
+    for op in root.ops:
+        live.update(op.input_names())
+        live.update(op.output_names())
+    assert loss.name in live
+    assert protected <= set(root.vars) | {"x", "y"}
+
+
+def test_reuse_skips_sub_block_referenced_names():
+    """Names read inside a while body must keep their identity — the
+    reuse pass may neither rename them nor hand their buffer to a new
+    tenant."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8])
+        h = layers.fc(x, size=8, act="relu")
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 3)
+        acc = layers.fill_constant([1, 8], "float32", 0.0)
+        w = layers.While(layers.less_than(i, n))
+        with w.block():
+            acc2 = layers.elementwise_add(acc, h)
+            layers.assign(acc2, acc)
+            layers.assign(layers.increment(i), i)
+            layers.assign(layers.less_than(i, n), w.cond_var)
+        out = layers.mean(acc)
+    os.environ["PADDLE_TPU_INPLACE_REUSE"] = "1"
+    try:
+        res = rewrite.rewrite_program(main, feed_names=["x"],
+                                      fetch_names=[out.name])
+    finally:
+        os.environ.pop("PADDLE_TPU_INPLACE_REUSE", None)
+    touched = {a["var"] for a in res.actions
+               if a["pass"] == "inplace_reuse"}
+    touched |= {a["into"] for a in res.actions
+                if a["pass"] == "inplace_reuse"}
+    sub_refs = set()
+    for blk in res.program.blocks[1:]:
+        for op in blk.ops:
+            sub_refs.update(op.input_names())
+            sub_refs.update(op.output_names())
+    assert not touched & sub_refs, touched & sub_refs
+    assert "inplace_reuse" not in res.aborted
+
+
+def test_reuse_loss_values_bit_exact_across_arms(tmp_path):
+    """Subprocess A/B (fresh compile caches per arm): three SGD steps
+    of the MLP produce bit-identical losses with reuse off vs on."""
+    script = tmp_path / "arm.py"
+    script.write_text("""
+import os, sys
+os.environ["PADDLE_TPU_INPLACE_REUSE"] = sys.argv[1]
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+np.random.seed(0)
+main, startup = pt.Program(), pt.Program()
+with pt.program_guard(main, startup):
+    x = layers.data("x", [32])
+    y = layers.data("y", [1])
+    h = layers.fc(x, size=64, act="relu")
+    h = layers.fc(h, size=64, act="relu")
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+    optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+exe = pt.Executor()
+exe.run(startup)
+feed = {"x": np.random.rand(4, 32).astype(np.float32),
+        "y": np.random.rand(4, 1).astype(np.float32)}
+out = [repr(float(np.ravel(np.asarray(
+    exe.run(main, feed=feed, fetch_list=[loss])[0]))[0]))
+    for _ in range(3)]
+print(";".join(out))
+""")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO)
+    runs = {}
+    for arm in ("0", "1"):
+        r = subprocess.run([sys.executable, str(script), arm],
+                           capture_output=True, text=True, timeout=300,
+                           env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
+        runs[arm] = r.stdout.strip().splitlines()[-1]
+    assert runs["0"] == runs["1"], runs
+
+
+# ---------------------------------------------------------------------------
+# pre-compile OOM gate
+# ---------------------------------------------------------------------------
+def test_check_budget_diagnostic_structure():
+    main, _startup, _loss = _mlp()
+    rep = memory.program_memory(main, batch=4, feed_names=["x", "y"])
+    vr = memory.check_budget(rep, budget=1)
+    assert not vr.ok
+    d = vr.by_code("hbm-oom")[0]
+    assert d.op_index == rep.high_water["op_index"]
+    assert "PADDLE_TPU_HBM_BYTES" in d.hint
+    # top offenders are named with their sizes
+    assert rep.top(1)[0].name in d.message
+    # a zero/absent budget never errors
+    assert memory.check_budget(rep, budget=0).ok
+    assert memory.check_budget(rep, budget=rep.peak_bytes).ok
+
+
+def test_executor_gate_raises_before_compile(monkeypatch):
+    main, startup, loss = _mlp()
+    exe = pt.Executor()
+    scope = pt.Scope()
+    feed = {"x": np.random.rand(4, 32).astype(np.float32),
+            "y": np.random.rand(4, 1).astype(np.float32)}
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        # tighten the budget AFTER startup so only the train program
+        # (whose resident params alone blow 128 B) hits the gate
+        monkeypatch.setenv("PADDLE_TPU_HBM_BYTES", "128")
+        with pytest.raises(VerificationError) as ei:
+            exe.run(main, feed=feed, fetch_list=[loss])
+    msg = str(ei.value)
+    assert "hbm-oom" in msg and "pre-compile memory gate" in msg
+    # nothing was cached for this program: raising the budget lets the
+    # same executor compile and run the same program
+    monkeypatch.setenv("PADDLE_TPU_HBM_BYTES", "0")
+    with pt.scope_guard(scope):
+        out = exe.run(main, feed=feed, fetch_list=[loss])
+    assert np.isfinite(float(np.ravel(np.asarray(out[0]))[0]))
+    assert exe.last_memory is not None
+    assert exe.last_memory.peak_bytes > 0
+
+
+def test_run_result_carries_memory_report():
+    main, startup, loss = _mlp()
+    exe = pt.Executor()
+    scope = pt.Scope()
+    feed = {"x": np.random.rand(4, 32).astype(np.float32),
+            "y": np.random.rand(4, 1).astype(np.float32)}
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])
+    mem = exe.last_memory
+    assert mem is not None
+    # the gate planned the post-rewrite executable with REAL feed
+    # shapes: the fed batch of 4 is bound, not the declared -1
+    by_name = {v.name: v for v in mem.intervals}
+    assert by_name["x"].bytes == 4 * 32 * 4
+
+
+# ---------------------------------------------------------------------------
+# benchmark harness (importable static path)
+# ---------------------------------------------------------------------------
+def test_memory_plan_ab_static_reduction():
+    sys.path.insert(0, os.path.join(_REPO, "benchmarks"))
+    try:
+        import memory_plan_ab as ab
+    finally:
+        sys.path.pop(0)
+
+    class _Args:
+        vocab, n_layer, n_head = 64, 1, 2
+        d_model, d_inner, batch = 32, 64, 2
+    build = ab._transformer_build(_Args, 16)
+    entry = ab.static_ab(build, _Args.batch, "transformer_s16")
+    assert entry["on"]["reuse_actions"] > 0
+    assert entry["peak_reduction_pct"] >= 20.0, entry
+    assert entry["off"]["rewrite_aborted"] == []
+    assert entry["on"]["rewrite_aborted"] == []
+
+
+# ---------------------------------------------------------------------------
+# metric publication
+# ---------------------------------------------------------------------------
+def test_publish_peak_gauge():
+    from paddle_tpu.observability.registry import default_registry
+    memory.publish_peak("planner_test", 12345)
+    fam = default_registry().get("paddle_tpu_memory_peak_bytes")
+    vals = {key: g.value for key, g in fam.samples()}
+    assert vals[("planner_test",)] == 12345.0

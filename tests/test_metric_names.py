@@ -160,7 +160,10 @@ def test_registry_names_and_help_after_smoke_run(tmp_path):
                      "paddle_tpu_embed_applies_total",
                      "paddle_tpu_embed_cache_refreshes_total",
                      "paddle_tpu_embed_cache_staleness_steps",
-                     "paddle_tpu_embed_table_rows"):
+                     "paddle_tpu_embed_table_rows",
+                     # ISSUE 20: memory-planner families
+                     "paddle_tpu_memory_peak_bytes",
+                     "paddle_tpu_memory_reuse_bytes_total"):
         assert expected in names, f"smoke run did not publish {expected}"
     # the generation smoke shed exactly through the host budget path
     gen_shed = {key for key, _ in

@@ -402,6 +402,36 @@ def test_control_flow_ops_have_explicit_infer_rules():
     assert ie_out.shape == (5, 8)        # mirrors the true branch
 
 
+def test_kv_cache_ops_have_explicit_infer_rules():
+    """The kv_cache update ops carry a pass-through infer rule: even
+    when the New operand has NO declared shape (the case the generic
+    abstract trace cannot evaluate), Out mirrors the Cache operand —
+    no skip marker, no shape-coverage warning, and the memory planner
+    sees the cache-resident bytes it must count."""
+    from paddle_tpu.framework import SHAPE_INFER_SKIPPED_ATTR
+    main = pt.Program()
+    blk = main.global_block()
+    cache = blk.create_var("kv_cache.t", shape=[2, 2, 16, 4],
+                           dtype="float32", persistable=True)
+    blk.create_var("new", dtype="float32")          # no shape
+    blk.create_var("slot", shape=[1], dtype="int64")
+    op = blk.append_op("kv_cache_write",
+                       {"Cache": cache, "New": "new", "Slot": "slot"},
+                       {"Out": "kv_cache.t"})
+    assert SHAPE_INFER_SKIPPED_ATTR not in op.attrs, op.attrs
+    out = main.desc.blocks[0].find_var_recursive("kv_cache.t")
+    assert list(out.shape) == [2, 2, 16, 4]
+    rep = analysis.verify_program(main, feed_names=["new", "slot"],
+                                  fetch_names=[])
+    assert not rep.by_code("shape-coverage"), rep.render_text()
+    # and the planner counts the resident cache buffer
+    from paddle_tpu.analysis.memory import program_memory
+    mem = program_memory(main)
+    resident = {v.name: v for v in mem.intervals
+                if v.kind == "resident"}
+    assert resident["kv_cache.t"].bytes == 2 * 2 * 16 * 4 * 4
+
+
 # ---------------------------------------------------------------------------
 # diagnostic-colored DOT export
 # ---------------------------------------------------------------------------

@@ -330,6 +330,22 @@ def cost_report(network: str = None, model_dir: str = None,
     return cost_model.program_cost(prog, batch=batch, label=label)
 
 
+def memory_report(network: str = None, model_dir: str = None,
+                  batch: int = 1):
+    """Build/load the target program and return its MemoryReport
+    (analysis/memory.py): liveness intervals, peak-HBM estimate,
+    high-water op, top live tensors."""
+    from paddle_tpu.analysis import memory
+    if network:
+        main, _startup, feeds, _fetches = NETWORKS[network]()
+        prog, label = main, f"network {network!r}"
+    else:
+        prog, feeds, _fetches = _load_model_dir(model_dir)
+        label = f"model dir {model_dir!r}"
+    return memory.program_memory(prog, batch=batch, feed_names=feeds,
+                                 label=label)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="lint_ir",
@@ -357,6 +373,11 @@ def main(argv=None) -> int:
                     help="print the static cost-model table (per-op "
                          "FLOPs/bytes/params + totals) instead of "
                          "running the verifier")
+    ap.add_argument("--memory", action="store_true",
+                    help="print the static memory-planner table "
+                         "(analysis/memory.py: peak bytes, high-water "
+                         "op, top live tensors) instead of running "
+                         "the verifier")
     ap.add_argument("--optimize", action="store_true",
                     help="run the rewrite pipeline "
                          "(analysis/rewrite.py) offline and print the "
@@ -368,11 +389,12 @@ def main(argv=None) -> int:
                          "loss-only stance; auxiliary metric heads "
                          "then count as dead)")
     ap.add_argument("--batch", type=int, default=1,
-                    help="--cost: batch size bound to dynamic (-1) "
-                         "dims (default 1)")
+                    help="--cost/--memory: batch size bound to "
+                         "dynamic (-1) dims (default 1)")
     ap.add_argument("--limit", type=int, default=20,
-                    help="--cost: table rows to print (heaviest "
-                         "first; default 20)")
+                    help="--cost/--memory: table rows to print "
+                         "(heaviest first; default 20, --memory "
+                         "default 10)")
     args = ap.parse_args(argv)
 
     if args.list_networks:
@@ -387,6 +409,14 @@ def main(argv=None) -> int:
                            model_dir=args.model_dir, batch=args.batch)
         print(cost.to_json(indent=2) if args.json
               else cost.table(limit=args.limit))
+        return 0
+
+    if args.memory:
+        mem = memory_report(network=args.network,
+                            model_dir=args.model_dir, batch=args.batch)
+        limit = min(args.limit, 10) if args.limit == 20 else args.limit
+        print(mem.to_json(indent=2) if args.json
+              else mem.table(limit=limit))
         return 0
 
     if args.optimize:
